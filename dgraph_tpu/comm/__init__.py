@@ -1,0 +1,13 @@
+from dgraph_tpu.comm.communicator import Communicator, TpuComm, SingleComm
+from dgraph_tpu.comm.mesh import make_graph_mesh, plan_in_specs, squeeze_plan
+from dgraph_tpu.comm import collectives
+
+__all__ = [
+    "Communicator",
+    "TpuComm",
+    "SingleComm",
+    "make_graph_mesh",
+    "plan_in_specs",
+    "squeeze_plan",
+    "collectives",
+]

@@ -1,0 +1,167 @@
+"""Differentiable distributed graph primitives (per-shard, inside shard_map).
+
+TPU-native re-design of the reference's L5 "differentiable comm primitives"
+(``DGraph/distributed/haloExchange.py``, ``nccl/_torch_func_impl.py``,
+SURVEY.md §1 L5):
+
+- ``HaloExchangeImpl`` (alltoallv by put-offsets, ``haloExchange.py:37-88``)
+  ↦ :func:`halo_exchange`: a feature gather + one ``lax.all_to_all`` whose
+  received blocks land directly in halo-slot order (no recv scatter needed).
+- ``CommPlan_GatherFunction`` (local copy → all_to_all → boundary scatter,
+  ``_torch_func_impl.py:27-191``) ↦ :func:`gather`.
+- ``CommPlan_ScatterFunction`` (``_torch_func_impl.py:194-352``) ↦
+  :func:`scatter_sum`.
+
+No custom_vjp is required: every op here is linear in the data (take,
+all_to_all, segment-sum, concat), and JAX's AD transposes them to exactly
+the reference's hand-written backward pairs (gather-bwd = scatter-sum with
+reversed splits, scatter-bwd = gather; ``_torch_func_impl.py:112-191,282-352``
+and ``haloExchange.py:66-88``). The gradient tests in
+``tests/test_collectives_grad.py`` pin this against the analytic transpose.
+
+All functions take the PER-SHARD plan (leading [world_size] axis already
+split off by shard_map; see :func:`dgraph_tpu.comm.mesh.squeeze_plan`) and an
+``axis_name`` (None = single-device, world_size must be 1 — the reference's
+SingleProcessDummyCommunicator pattern, ``GraphCast/dist_utils.py:8-39``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dgraph_tpu.plan import EdgePlan, HaloSpec
+from dgraph_tpu.ops import local as local_ops
+
+
+def halo_exchange(
+    x: jax.Array, halo: HaloSpec, axis_name: Optional[str]
+) -> jax.Array:
+    """Exchange boundary vertex features; returns the halo buffer.
+
+    Args:
+      x: [n_pad, F] local (padded) vertex features of this shard.
+      halo: per-shard spec; send_idx [W, S], send_mask [W, S].
+      axis_name: mesh axis to exchange over, or None (single device).
+
+    Returns: [W*S, F] halo features; the block from peer p occupies rows
+    ``[p*S, (p+1)*S)`` — i.e. exactly the halo-slot numbering the plan
+    builder used for edge indices.
+    """
+    send = x[halo.send_idx] * halo.send_mask[..., None]  # [W, S, F]
+    if axis_name is None:
+        recv = send  # world_size 1: no cross edges; mask is all-zero
+    else:
+        recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0)
+    return recv.reshape(-1, x.shape[-1])
+
+
+def halo_scatter_sum(
+    h: jax.Array, halo: HaloSpec, n_pad: int, axis_name: Optional[str]
+) -> jax.Array:
+    """Linear transpose of :func:`halo_exchange`: deliver halo-slot values
+    back to their owner ranks and sum into local vertices.
+
+    This is the reference's halo-exchange backward (reversed put offsets,
+    ``haloExchange.py:66-88``) and the boundary leg of
+    ``CommPlan_ScatterFunction.forward`` (``_torch_func_impl.py:194-280``).
+
+    Args:
+      h: [W*S, F] halo-buffer values on this shard.
+    Returns: [n_pad, F] per-local-vertex sums.
+    """
+    W = halo.send_idx.shape[0]
+    h = h.reshape(W, halo.s_pad, -1)
+    if axis_name is None:
+        back = h
+    else:
+        back = lax.all_to_all(h, axis_name, split_axis=0, concat_axis=0)
+    back = back * halo.send_mask[..., None]
+    flat_idx = halo.send_idx.reshape(-1)
+    return local_ops.segment_sum(back.reshape(flat_idx.shape[0], -1), flat_idx, n_pad)
+
+
+def _side_index(plan: EdgePlan, side: str) -> jax.Array:
+    return plan.src_index if side == "src" else plan.dst_index
+
+
+def _side_npad(plan: EdgePlan, side: str) -> int:
+    return plan.n_src_pad if side == "src" else plan.n_dst_pad
+
+
+def gather(
+    x: jax.Array, plan: EdgePlan, side: str, axis_name: Optional[str]
+) -> jax.Array:
+    """Per-edge features gathered from one endpoint side.
+
+    Parity: ``Communicator.gather`` / ``CommPlan_GatherFunction``
+    (``_torch_func_impl.py:27-110``): local vertex→edge copy + boundary
+    all_to_all + received-row placement. Here the non-halo side is a pure
+    local take; the halo side prepends one halo exchange.
+
+    Args:
+      x: [n_pad, F] per-shard vertex features for that side's vertex set.
+    Returns: [e_pad, F] per-edge features (masked edges are zero).
+    """
+    idx = _side_index(plan, side)
+    if side == plan.halo_side:
+        haloed = halo_exchange(x, plan.halo, axis_name)
+        full = jnp.concatenate([x, haloed], axis=0)
+    else:
+        full = x
+    return full[idx] * plan.edge_mask[:, None]
+
+
+def scatter_sum(
+    edata: jax.Array, plan: EdgePlan, side: str, axis_name: Optional[str]
+) -> jax.Array:
+    """Sum per-edge values into that side's vertices (cross-rank aware).
+
+    Parity: ``Communicator.scatter`` / ``CommPlan_ScatterFunction``
+    (``_torch_func_impl.py:194-280``). TPU has no remote atomics (the NVSHMEM
+    backend's CAS scatter-add, ``nvshmem_comm_kernels.cuh:17-54``), so the
+    remote leg is: local segment-sum into halo slots (pre-aggregation per
+    unique remote vertex — the reference's dedup does the same,
+    ``_NCCLCommPlan.py:221-226``) → reverse all_to_all → local segment-sum.
+
+    Args:
+      edata: [e_pad, F] per-edge values.
+    Returns: [n_pad, F] per-vertex sums for the requested side.
+    """
+    edata = edata * plan.edge_mask[:, None]
+    idx = _side_index(plan, side)
+    n_pad = _side_npad(plan, side)
+    if side != plan.halo_side:
+        return local_ops.segment_sum(edata, idx, n_pad)
+    W = plan.world_size
+    full = local_ops.segment_sum(edata, idx, n_pad + W * plan.halo.s_pad)
+    local_part = full[:n_pad]
+    remote_part = full[n_pad:]
+    return local_part + halo_scatter_sum(remote_part, plan.halo, n_pad, axis_name)
+
+
+def gather_concat(
+    x_src: jax.Array,
+    x_dst: jax.Array,
+    plan: EdgePlan,
+    axis_name: Optional[str],
+) -> jax.Array:
+    """[e_pad, F_src+F_dst] concat of src- and dst-side per-edge features.
+
+    The reference's GCN/GAT layers start with exactly this double gather
+    (``experiments/OGB/GCN.py:28-67``, ``RGAT.py:174-206``).
+    """
+    hs = gather(x_src, plan, "src", axis_name)
+    hd = gather(x_dst, plan, "dst", axis_name)
+    return jnp.concatenate([hs, hd], axis=-1)
+
+
+def psum_mean(x, axis_name: Optional[str]):
+    """Mean over a mesh axis (None = identity). For DP gradient sync —
+    replaces the reference's DDP all-reduce (``experiments/OGB/main.py:111``)."""
+    if axis_name is None:
+        return x
+    return lax.pmean(x, axis_name)

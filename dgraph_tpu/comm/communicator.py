@@ -1,0 +1,156 @@
+"""Communicator facade — the user-facing API, parity with
+``DGraph/Communicator.py`` (SURVEY.md §1 L4).
+
+The reference validates a backend name in {nccl, mpi, nvshmem} and forwards
+every call to a backend engine (``Communicator.py:24-141``). On TPU there is
+one runtime (XLA), so the "backends" collapse to two *modes*:
+
+- ``"tpu"`` (:class:`TpuComm`): SPMD over a mesh axis; methods must be
+  called inside ``shard_map`` (or a jitted function with the mesh bound).
+  Collectives lower to XLA ``all_to_all``/``psum`` over ICI/DCN — the
+  NCCL/NVSHMEM/MPI wire mechanics (SURVEY.md §2.4) are all subsumed.
+- ``"single"`` (:class:`SingleComm`): world size 1, no collectives — the
+  reference's ``SingleProcessDummyCommunicator`` pattern
+  (``GraphCast/dist_utils.py:8-39``), used so model code is testable
+  without a mesh. Model code is byte-identical under either comm — the
+  reference's key "fake backend" design point, kept on purpose.
+
+Unlike the reference there is no process-group initialization to perform
+(no ``init_process_group`` collective; ``jax.distributed.initialize`` is
+only needed for true multi-host runs and is orthogonal to this object), so
+``Communicator.init_process_group`` simply constructs the right comm object.
+Methods that exist purely for API parity (``barrier``, ``destroy``,
+``alloc_buffer``) are cheap no-ops or jnp allocations, documented as such.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dgraph_tpu.comm import collectives
+from dgraph_tpu.comm.mesh import GRAPH_AXIS, REPLICA_AXIS
+from dgraph_tpu.plan import EdgePlan, HaloSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class _BaseComm:
+    """Static (hashable, non-pytree) comm descriptor; safe as a flax module
+    attribute or jit static arg."""
+
+    graph_axis: Optional[str]
+    replica_axis: Optional[str]
+
+    # -- world/rank introspection (inside shard_map for tpu mode) --
+    def get_rank(self):
+        if self.graph_axis is None:
+            return 0
+        return lax.axis_index(self.graph_axis)
+
+    def get_world_size(self) -> int:
+        raise NotImplementedError
+
+    # -- the differentiable primitives (L5) --
+    def halo_exchange(self, x, halo: HaloSpec):
+        return collectives.halo_exchange(x, halo, self.graph_axis)
+
+    def gather(self, x, plan: EdgePlan, side: str = "src"):
+        return collectives.gather(x, plan, side, self.graph_axis)
+
+    def gather_concat(self, x_src, x_dst, plan: EdgePlan):
+        return collectives.gather_concat(x_src, x_dst, plan, self.graph_axis)
+
+    def scatter(self, edata, plan: EdgePlan, side: str = "dst"):
+        """Scatter-add per-edge values to vertices (``op=sum`` only, like the
+        reference's maintained path, ``NCCLBackendEngine.py:183-215``)."""
+        return collectives.scatter_sum(edata, plan, side, self.graph_axis)
+
+    scatter_sum = scatter
+
+    # -- reductions over mesh axes --
+    def all_reduce_sum(self, x):
+        if self.graph_axis is None:
+            return x
+        return lax.psum(x, self.graph_axis)
+
+    def all_reduce_mean(self, x):
+        if self.graph_axis is None:
+            return x
+        return lax.pmean(x, self.graph_axis)
+
+    def replica_mean(self, x):
+        if self.replica_axis is None:
+            return x
+        return lax.pmean(x, self.replica_axis)
+
+    def grad_sync(self, grads):
+        """Mean gradients over every parallel axis (graph + replica) — the
+        DDP all-reduce equivalent (``experiments/OGB/main.py:111-112``)."""
+        axes = tuple(a for a in (self.graph_axis, self.replica_axis) if a)
+        if not axes:
+            return grads
+        return jax.tree.map(lambda g: lax.pmean(g, axes), grads)
+
+    # -- parity no-ops --
+    def barrier(self):
+        """No-op: XLA's dataflow scheduling orders collectives; the
+        reference's liberal ``dist.barrier()`` has no TPU analogue."""
+
+    def destroy(self):
+        """No-op (reference parity; and note ``Communicator.destroy`` in the
+        reference never called the engine's destroy either — SURVEY §2.6)."""
+
+    def alloc_buffer(self, shape, dtype=jnp.float32):
+        """Parity with ``Communicator.alloc_buffer`` (``Communicator.py:99``):
+        on TPU buffers are values, not symmetric-heap allocations."""
+        return jnp.zeros(shape, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuComm(_BaseComm):
+    """SPMD communicator bound to mesh axis names. Use inside shard_map."""
+
+    world_size: int = 1
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+
+@dataclasses.dataclass(frozen=True)
+class SingleComm(_BaseComm):
+    """World-size-1 communicator (no mesh, no collectives)."""
+
+    def get_world_size(self) -> int:
+        return 1
+
+
+class Communicator:
+    """Constructor facade, parity with ``DGraph/Communicator.py:24-66``."""
+
+    SUPPORTED_BACKENDS = ("tpu", "single")
+
+    @staticmethod
+    def init_process_group(
+        backend: str = "tpu",
+        *,
+        world_size: Optional[int] = None,
+        graph_axis: str = GRAPH_AXIS,
+        replica_axis: Optional[str] = None,
+    ) -> _BaseComm:
+        if backend == "tpu":
+            if world_size is None:
+                raise ValueError("backend='tpu' requires world_size (graph-axis size)")
+            return TpuComm(
+                graph_axis=graph_axis, replica_axis=replica_axis, world_size=world_size
+            )
+        if backend == "single":
+            return SingleComm(graph_axis=None, replica_axis=replica_axis)
+        raise ValueError(
+            f"Backend {backend!r} not supported; expected one of "
+            f"{Communicator.SUPPORTED_BACKENDS} (the reference's nccl/mpi/nvshmem "
+            "backends are all subsumed by 'tpu' — SURVEY.md §2.4)"
+        )

@@ -1,0 +1,62 @@
+"""Mesh construction and sharding helpers.
+
+The reference composes partition groups × replicas by integer arithmetic on
+ranks (``ranks_per_graph``; ``NCCLBackendEngine.py:56-64``,
+``GraphCast/dist_utils.py:50-113``). On TPU this is a 2-D
+``jax.sharding.Mesh`` with axes ``('replica', 'graph')``: graph-partition
+collectives ride the inner (ICI-contiguous) ``graph`` axis; data-parallel
+gradient sync rides ``replica`` (ICI or DCN for multi-slice — XLA routes
+hybrid meshes automatically).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+GRAPH_AXIS = "graph"
+REPLICA_AXIS = "replica"
+
+
+def make_graph_mesh(
+    ranks_per_graph: Optional[int] = None,
+    num_replicas: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ``('replica', 'graph')`` mesh.
+
+    ``ranks_per_graph`` defaults to (num_devices / num_replicas) — the
+    reference's ``ranks_per_graph`` knob (``NCCLBackendEngine.py:56-64``).
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if ranks_per_graph is None:
+        ranks_per_graph = n // num_replicas
+    if ranks_per_graph * num_replicas != n:
+        raise ValueError(
+            f"ranks_per_graph ({ranks_per_graph}) x num_replicas ({num_replicas})"
+            f" != device count ({n})"
+        )
+    return jax.make_mesh(
+        (num_replicas, ranks_per_graph), (REPLICA_AXIS, GRAPH_AXIS), devices=devices
+    )
+
+
+def plan_in_specs(plan) -> object:
+    """A pytree of ``P('graph')`` matching ``plan``'s structure, for shard_map
+    in_specs: every plan leaf has a leading [world_size] axis."""
+    return jax.tree.map(lambda _: P(GRAPH_AXIS), plan)
+
+
+def squeeze_plan(plan):
+    """Drop the leading per-shard axis of size 1 that shard_map leaves on
+    every plan leaf (use inside the shard_map body)."""
+    return jax.tree.map(lambda leaf: leaf[0], plan)
+
+
+def replicated_specs(tree) -> object:
+    """P() (fully replicated) specs for a pytree (e.g. model params)."""
+    return jax.tree.map(lambda _: P(), tree)

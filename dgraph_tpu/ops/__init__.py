@@ -1,0 +1,3 @@
+from dgraph_tpu.ops import local
+
+__all__ = ["local"]

@@ -1,0 +1,80 @@
+"""Rank-local gather/scatter primitives (the ``torch_local`` CUDA kernels'
+TPU equivalents).
+
+Reference: ``DGraph/distributed/RankLocalOps.py`` +
+``DGraph/distributed/csrc/local_data_kernels.cuh`` — masked gather
+(``Rank_Local_Gather_Kernel``, ``local_data_kernels.cuh:160-206``),
+atomicAdd scatter (``:208-253``), generic set/add masked scatter-gather
+(``:301-342``) with a float4-vectorized variant (``:353-406``).
+
+TPU-first: there are no atomics on TPU; scatter-add is expressed as a
+segment reduction, which XLA lowers to an efficient sorted/one-hot scheme
+on the MXU/VPU, and which a Pallas kernel (``dgraph_tpu.ops.pallas_segment``)
+can further specialize for sorted-by-destination edge plans (the plan
+builder already emits dst-sorted edges within each rank — same prerequisite
+the reference's dedup/renumbering establishes for its alltoallv path).
+
+The reference keeps a torch fallback beside its CUDA kernels
+(``RankLocalOps.py:21-31,66-70``); we keep jnp implementations beside the
+Pallas kernels the same way — the jnp path is also the oracle in tests.
+
+This module is the single dispatch point: swap ``segment_sum`` here and
+every collective / model picks it up.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_gather(src: jax.Array, idx: jax.Array, mask: jax.Array) -> jax.Array:
+    """out[i] = src[idx[i]] * mask[i] — ``Rank_Local_Gather_Kernel`` parity."""
+    return src[idx] * mask[..., None]
+
+
+def segment_sum(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    """Sum rows of ``data`` into ``num_segments`` buckets by ``segment_ids``.
+
+    The TPU replacement for atomicAdd scatter (``local_data_kernels.cuh:208-253``).
+    """
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_max(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+    """Per-segment max (for attention softmax stabilization). Empty segments
+    produce -inf; callers mask afterwards."""
+    return jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+
+
+def segment_mean(
+    data: jax.Array, segment_ids: jax.Array, num_segments: int, eps: float = 1e-12
+) -> jax.Array:
+    """Per-segment mean with safe division for empty segments."""
+    sums = segment_sum(data, segment_ids, num_segments)
+    counts = segment_sum(jnp.ones((data.shape[0], 1), data.dtype), segment_ids, num_segments)
+    return sums / jnp.maximum(counts, eps)
+
+
+def segment_softmax(
+    logits: jax.Array, segment_ids: jax.Array, num_segments: int, mask: jax.Array
+) -> jax.Array:
+    """Numerically-stable softmax over segments (per-dst-vertex attention).
+
+    The reference RGAT computes this with an explicit gather/scatter round
+    trip over the network (denominator scatter + gather,
+    ``experiments/OGB-LSC/RGAT.py:174-206``); with dst-owned edges it is a
+    purely local segment operation.
+
+    Args:
+      logits: [E, H] per-edge (per-head) attention logits.
+      mask: [E] 1.0 for real edges.
+    Returns [E, H] normalized weights (masked edges -> 0).
+    """
+    logits = jnp.where(mask[..., None] > 0, logits, -jnp.inf)
+    seg_max = segment_max(logits, segment_ids, num_segments)
+    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
+    shifted = jnp.where(mask[..., None] > 0, logits - seg_max[segment_ids], -jnp.inf)
+    expd = jnp.where(mask[..., None] > 0, jnp.exp(shifted), 0.0)
+    denom = segment_sum(expd, segment_ids, num_segments)
+    return expd / jnp.maximum(denom[segment_ids], 1e-12)

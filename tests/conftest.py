@@ -1,0 +1,44 @@
+"""Test environment: force JAX onto 8 virtual CPU devices.
+
+This replaces the reference's torchrun/mpirun multi-process test launches
+(``tests/README.md:1-17``): with ``xla_force_host_platform_device_count`` we
+get *real* multi-device SPMD semantics (true all_to_all/psum over 8 device
+shards) in a single process with no cluster — SURVEY.md §4.
+
+Must run before any test module imports jax. PALLAS_AXON_POOL_IPS is cleared
+because the baked axon sitecustomize pins JAX_PLATFORMS to the (single-chip)
+TPU tunnel when it is set.
+"""
+
+import os
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The baked axon sitecustomize imports jax at interpreter startup (before this
+# conftest), freezing jax_platforms='axon' from the ambient env. Backend
+# initialization is lazy, so overriding the config here (before any jax API
+# call touches devices) still redirects to the virtual CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from dgraph_tpu.comm.mesh import make_graph_mesh
+
+    assert len(jax.devices()) == 8, "conftest env did not take effect"
+    return make_graph_mesh(ranks_per_graph=8)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

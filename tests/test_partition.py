@@ -1,0 +1,54 @@
+import numpy as np
+import pytest
+
+from dgraph_tpu import partition as pt
+
+
+def ring_graph(n):
+    src = np.arange(n)
+    dst = (src + 1) % n
+    # symmetrize
+    return np.stack([np.concatenate([src, dst]), np.concatenate([dst, src])])
+
+
+def test_round_robin():
+    p = pt.round_robin_partition(10, 4)
+    assert p.tolist() == [0, 1, 2, 3, 0, 1, 2, 3, 0, 1]
+
+
+def test_block_partition_balanced():
+    p = pt.block_partition(10, 4)
+    counts = np.bincount(p, minlength=4)
+    assert counts.sum() == 10 and counts.max() - counts.min() <= 3
+    assert np.all(np.diff(p) >= 0)
+
+
+@pytest.mark.parametrize("method", ["round_robin", "block", "random", "rcm", "greedy_bfs"])
+def test_partition_graph_all_methods(method):
+    edges = ring_graph(32)
+    new_edges, ren = pt.partition_graph(edges, 32, 4, method=method)
+    # every vertex assigned, blocks contiguous, perm is a bijection
+    assert ren.counts.sum() == 32
+    assert np.all(np.diff(ren.partition) >= 0)
+    assert sorted(ren.perm.tolist()) == list(range(32))
+    # renumbered edges preserve adjacency structure
+    old_set = set(map(tuple, edges.T.tolist()))
+    back = ren.inv[new_edges]
+    assert set(map(tuple, back.T.tolist())) == old_set
+
+
+def test_rcm_locality_beats_round_robin():
+    edges = ring_graph(256)
+    rr = pt.round_robin_partition(256, 8)
+    rcm = pt.rcm_partition(edges, 256, 8)
+    assert pt.edge_cut(edges, rcm) < pt.edge_cut(edges, rr)
+
+
+def test_renumber_contiguous_inverse():
+    part = np.array([2, 0, 1, 0, 2, 1, 0])
+    ren = pt.renumber_contiguous(part, 3)
+    assert ren.counts.tolist() == [3, 2, 2]
+    # inv/perm are inverses
+    assert np.all(ren.perm[ren.inv] == np.arange(7))
+    # new partition assigns the same rank each old vertex had
+    assert np.all(ren.partition[ren.perm] == part)

@@ -1,0 +1,163 @@
+"""Plan-builder tests: hand-analyzed tiny graphs (the reference's
+test_comm_info.py strategy — SURVEY.md §4) plus structural invariants on
+random graphs.
+
+Hand-analyzed graph (own design, 4 vertices, 2 ranks, contiguous blocks):
+
+    ranks:  v0,v1 -> rank 0;  v2,v3 -> rank 1
+    edges:  0->1, 1->2, 2->3, 3->0, 0->2
+
+Reference-convention (edge owner = src) expectations, derived by hand:
+  rank 0: local {0,1}; owned edges (0,1),(1,2),(0,2); halo {2};
+          sends {0,1} to rank 1 (dedup of (0,r1),(1,r1)); recv 1 vertex (3).
+  rank 1: local {2,3}; owned edges (2,3),(3,0); halo {0};
+          sends {3} to rank 0; recv {0,1}.
+  comm_map = [[0, 2], [1, 0]]
+"""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu import plan as pl
+
+EDGES = np.array([[0, 1, 2, 3, 0], [1, 2, 3, 0, 2]])
+PART = np.array([0, 0, 1, 1])
+
+
+class TestCommPattern:
+    def test_rank0(self):
+        cp = pl.build_comm_pattern(EDGES, PART, rank=0, world_size=2)
+        assert cp.num_local_vertices == 2
+        assert cp.num_halo_vertices == 1
+        # local edges: (0,1),(1,2),(0,2) with halo vertex 2 -> local id 2
+        assert cp.local_edge_list.tolist() == [[0, 1], [1, 2], [0, 2]]
+        assert cp.send_local_idx.tolist() == [0, 1]
+        assert cp.send_offset.tolist() == [0, 0, 2]
+        assert cp.comm_map.tolist() == [[0, 2], [1, 0]]
+        assert cp.recv_offset.tolist() == [0, 0, 1]
+        assert cp.put_forward_remote_offset.tolist() == [0, 0]
+
+    def test_rank1(self):
+        cp = pl.build_comm_pattern(EDGES, PART, rank=1, world_size=2)
+        assert cp.num_local_vertices == 2
+        assert cp.num_halo_vertices == 1
+        # local edges: (2,3),(3,0); local ids 2->0, 3->1, halo 0 -> 2
+        assert cp.local_edge_list.tolist() == [[0, 1], [1, 2]]
+        assert cp.send_local_idx.tolist() == [1]  # vertex 3 -> local id 1
+        assert cp.send_offset.tolist() == [0, 1, 1]
+        assert cp.recv_offset.tolist() == [0, 2, 2]
+        # one-sided put offsets: forward = sum of rows < rank of comm_map
+        assert cp.put_forward_remote_offset.tolist() == [0, 2]
+
+    def test_comm_map_consistent_across_ranks(self):
+        cps = [pl.build_comm_pattern(EDGES, PART, r, 2) for r in range(2)]
+        assert np.array_equal(cps[0].comm_map, cps[1].comm_map)
+        # row sums == per-rank total sends, col sums == total recvs
+        cm = cps[0].comm_map
+        for r in range(2):
+            assert cm[r].sum() == cps[r].send_offset[-1] - cps[r].send_offset[0]
+            assert cm[:, r].sum() == cps[r].recv_offset[-1]
+
+
+def decode_plan_edges(plan, layout):
+    """Reconstruct global [2, E] edges from a padded EdgePlan (test helper)."""
+    W = plan.world_size
+    src_off = np.concatenate([[0], np.cumsum(layout.src_counts)])
+    dst_off = np.concatenate([[0], np.cumsum(layout.dst_counts)])
+    halo_off = src_off if plan.halo_side == "src" else dst_off
+    send_idx = np.asarray(plan.halo.send_idx)
+    s = plan.halo.s_pad
+    out = []
+    for r in range(W):
+        mask = np.asarray(plan.edge_mask[r]) > 0
+        for j in np.nonzero(mask)[0]:
+            si, di = int(plan.src_index[r, j]), int(plan.dst_index[r, j])
+
+            def decode(idx, n_pad, off, is_halo_side):
+                if not is_halo_side or idx < n_pad:
+                    return off[r] + idx
+                h = idx - n_pad
+                p, i = divmod(h, s)
+                return halo_off[p] + int(send_idx[p, r, i])
+
+            g_src = decode(si, plan.n_src_pad, src_off, plan.halo_side == "src")
+            g_dst = decode(di, plan.n_dst_pad, dst_off, plan.halo_side == "dst")
+            out.append((g_src, g_dst))
+    return out
+
+
+class TestEdgePlan:
+    def test_hand_analyzed_dst_owner(self):
+        plan, layout = pl.build_edge_plan(
+            EDGES, PART, world_size=2, edge_owner="dst", pad_multiple=1
+        )
+        assert plan.halo_side == "src"
+        # rank0 owns edges with dst in {0,1}: (0,1),(3,0); rank1: (1,2),(2,3),(0,2)
+        assert plan.num_edges.tolist() == [2, 3]
+        assert plan.e_pad == 3
+        # halo: rank0 needs src 3 (from rank1); rank1 needs srcs {0,1} (from rank0)
+        assert layout.halo_counts.tolist() == [[0, 2], [1, 0]]
+        assert plan.halo.s_pad == 2
+        # sends: rank0 -> rank1: local ids [0,1]; rank1 -> rank0: local id [1]
+        assert plan.halo.send_idx[0, 1].tolist() == [0, 1]
+        assert plan.halo.send_mask[0, 1].tolist() == [1.0, 1.0]
+        assert plan.halo.send_idx[1, 0, 0] == 1
+        assert plan.halo.send_mask[1, 0].tolist() == [1.0, 0.0]
+
+    def test_hand_analyzed_src_owner(self):
+        plan, layout = pl.build_edge_plan(
+            EDGES, PART, world_size=2, edge_owner="src", pad_multiple=1
+        )
+        assert plan.halo_side == "dst"
+        # src ownership: rank0 owns (0,1),(1,2),(0,2); rank1 owns (2,3),(3,0)
+        assert plan.num_edges.tolist() == [3, 2]
+        # halo: rank0 needs dst 2 (from rank1); rank1 needs dst 0 (from rank0)
+        assert layout.halo_counts.tolist() == [[0, 1], [1, 0]]
+
+    @pytest.mark.parametrize("owner", ["src", "dst"])
+    @pytest.mark.parametrize("world", [1, 2, 4, 8])
+    def test_roundtrip_random_graph(self, owner, world, rng):
+        V, E = 50, 400
+        edges = rng.integers(0, V, size=(2, E))
+        part = np.sort(rng.integers(0, world, size=V)).astype(np.int32)
+        plan, layout = pl.build_edge_plan(
+            edges, part, world_size=world, edge_owner=owner
+        )
+        decoded = decode_plan_edges(plan, layout)
+        assert sorted(decoded) == sorted(map(tuple, edges.T.tolist()))
+
+    def test_bipartite_relation(self, rng):
+        """Hetero relation: 12 src (set A), 20 dst (set B), different partitions
+        — the RGAT edge-conditioned plan case (``_NCCLCommPlan.py:103-137``)."""
+        Va, Vb, E, W = 12, 20, 60, 4
+        edges = np.stack([rng.integers(0, Va, E), rng.integers(0, Vb, E)])
+        part_a = np.sort(rng.integers(0, W, Va)).astype(np.int32)
+        part_b = np.sort(rng.integers(0, W, Vb)).astype(np.int32)
+        plan, layout = pl.build_edge_plan(
+            edges, part_a, part_b, world_size=W, edge_owner="dst"
+        )
+        assert not plan.homogeneous
+        decoded = decode_plan_edges(plan, layout)
+        assert sorted(decoded) == sorted(map(tuple, edges.T.tolist()))
+
+    def test_edge_data_layout_roundtrip(self, rng):
+        from dgraph_tpu.plan import shard_edge_data
+        from dgraph_tpu.testing import unshard_edge_data
+
+        V, E, W = 30, 200, 4
+        edges = rng.integers(0, V, size=(2, E))
+        part = np.sort(rng.integers(0, W, V)).astype(np.int32)
+        plan, layout = pl.build_edge_plan(edges, part, world_size=W)
+        w = rng.normal(size=(E, 3)).astype(np.float32)
+        sharded = shard_edge_data(w, layout, plan.e_pad)
+        assert sharded.shape == (W, plan.e_pad, 3)
+        np.testing.assert_array_equal(unshard_edge_data(sharded, layout), w)
+
+    def test_vertex_data_roundtrip(self, rng):
+        from dgraph_tpu.plan import shard_vertex_data, unshard_vertex_data
+
+        counts = np.array([3, 5, 2, 4])
+        x = rng.normal(size=(14, 6)).astype(np.float32)
+        sh = shard_vertex_data(x, counts, n_pad=8)
+        assert sh.shape == (4, 8, 6)
+        np.testing.assert_array_equal(unshard_vertex_data(sh, counts), x)
