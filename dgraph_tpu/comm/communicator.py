@@ -88,12 +88,18 @@ class _BaseComm:
         return lax.pmean(x, self.replica_axis)
 
     def grad_sync(self, grads):
-        """Mean gradients over every parallel axis (graph + replica) — the
-        DDP all-reduce equivalent (``experiments/OGB/main.py:111-112``)."""
-        axes = tuple(a for a in (self.graph_axis, self.replica_axis) if a)
-        if not axes:
-            return grads
-        return jax.tree.map(lambda g: lax.pmean(g, axes), grads)
+        """Gradient synchronization — the DDP all-reduce equivalent
+        (``experiments/OGB/main.py:111-112``): SUM over the graph axis (each
+        shard holds a different slice of the one sample, so shard grads are
+        partial sums of the same global loss) and MEAN over the replica axis
+        (each replica holds a different sample). Matches the reference's
+        loss scaling ``* ranks_per_sample / world_size``
+        (``train_graphcast.py:29-34``)."""
+        if self.graph_axis is not None:
+            grads = jax.tree.map(lambda g: lax.psum(g, self.graph_axis), grads)
+        if self.replica_axis is not None:
+            grads = jax.tree.map(lambda g: lax.pmean(g, self.replica_axis), grads)
+        return grads
 
     # -- parity no-ops --
     def barrier(self):

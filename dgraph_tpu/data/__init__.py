@@ -1,0 +1,4 @@
+from dgraph_tpu.data.graph import DistributedGraph
+from dgraph_tpu.data import synthetic
+
+__all__ = ["DistributedGraph", "synthetic"]

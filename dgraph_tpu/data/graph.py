@@ -1,0 +1,128 @@
+"""DistributedGraph: one vertex-partitioned graph, plan + sharded tensors.
+
+Reference parity: ``DGraph/data/graph.py:24-268`` (DistributedGraph holding
+features/edge_index/labels + rank maps with per-rank slicing accessors) and
+``DGraph/data/preprocess.py`` (renumbering/edge sort). TPU-first: instead of
+per-rank slicing accessors, everything is stored stacked ``[W, n_pad, ...]``
+ready to place on the mesh with ``PartitionSpec('graph')``; masks replace the
+reference's node-range arithmetic (``graph.py:224-259``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from dgraph_tpu import partition as pt
+from dgraph_tpu.plan import (
+    EdgePlan,
+    EdgePlanLayout,
+    build_edge_plan,
+    shard_edge_data,
+    shard_vertex_data,
+)
+
+
+@dataclasses.dataclass
+class DistributedGraph:
+    num_nodes: int
+    num_edges: int
+    world_size: int
+    edge_index: np.ndarray  # [2, E] renumbered (contiguous per-rank blocks)
+    ren: pt.Renumbering
+    plan: EdgePlan
+    layout: EdgePlanLayout
+    features: np.ndarray  # [W, n_pad, F]
+    labels: Optional[np.ndarray]  # [W, n_pad] int32
+    masks: dict  # split name -> [W, n_pad] f32
+    vertex_mask: np.ndarray  # [W, n_pad] f32: 1.0 for real vertices
+    edge_weight: Optional[np.ndarray] = None  # [W, e_pad] f32
+
+    @classmethod
+    def from_global(
+        cls,
+        edge_index: np.ndarray,
+        features: np.ndarray,
+        labels: Optional[np.ndarray],
+        masks: Optional[dict],
+        world_size: int,
+        *,
+        partition_method: str = "rcm",
+        edge_owner: str = "dst",
+        add_symmetric_norm: bool = False,
+        pad_multiple: int = 8,
+        seed: int = 0,
+    ) -> "DistributedGraph":
+        num_nodes = features.shape[0]
+        edge_index = np.asarray(edge_index)
+        new_edges, ren = pt.partition_graph(
+            edge_index, num_nodes, world_size, method=partition_method, seed=seed
+        )
+        plan, layout = build_edge_plan(
+            new_edges,
+            ren.partition,
+            world_size=world_size,
+            edge_owner=edge_owner,
+            pad_multiple=pad_multiple,
+        )
+        n_pad = plan.n_src_pad
+        feats = shard_vertex_data(
+            np.asarray(features)[ren.inv], ren.counts, n_pad
+        ).astype(np.float32)
+        lab = (
+            shard_vertex_data(np.asarray(labels)[ren.inv].astype(np.int32), ren.counts, n_pad)
+            if labels is not None
+            else None
+        )
+        m = {}
+        if masks:
+            for k, v in masks.items():
+                m[k] = shard_vertex_data(
+                    np.asarray(v).astype(np.float32)[ren.inv], ren.counts, n_pad
+                )
+        vmask = shard_vertex_data(
+            np.ones(num_nodes, np.float32), ren.counts, n_pad
+        )
+        ew = None
+        if add_symmetric_norm:
+            ew = shard_edge_data(
+                symmetric_norm_weights(new_edges, num_nodes), layout, plan.e_pad
+            )
+        return cls(
+            num_nodes=num_nodes,
+            num_edges=edge_index.shape[1],
+            world_size=world_size,
+            edge_index=new_edges,
+            ren=ren,
+            plan=plan,
+            layout=layout,
+            features=feats,
+            labels=lab,
+            masks=m,
+            vertex_mask=vmask,
+            edge_weight=ew,
+        )
+
+    def batch(self, split: str) -> dict:
+        """Pytree for the train/eval step: leaves have leading [W] axis."""
+        out = {
+            "x": self.features,
+            "mask": self.masks[split] if split in self.masks else self.vertex_mask,
+        }
+        if self.labels is not None:
+            out["y"] = self.labels
+        if self.edge_weight is not None:
+            out["edge_weight"] = self.edge_weight
+        return out
+
+
+def symmetric_norm_weights(edge_index: np.ndarray, num_nodes: int) -> np.ndarray:
+    """Kipf-Welling GCN normalization 1/sqrt(d_src * d_dst) per edge."""
+    src, dst = edge_index
+    deg = np.zeros(num_nodes, np.float64)
+    np.add.at(deg, src, 1.0)
+    np.add.at(deg, dst, 1.0)
+    deg = np.maximum(deg, 1.0)
+    return (1.0 / np.sqrt(deg[src] * deg[dst])).astype(np.float32)

@@ -1,0 +1,80 @@
+"""Synthetic graph datasets for tests and benchmarks.
+
+The reference keeps synthetic generators beside every real dataset so the
+full stack is exercisable without downloads: synthetic MAG-like hetero graphs
+(``experiments/OGB-LSC/lsc_datasets/synthetic_dataset.py:37-76``) and a
+synthetic ERA5 weather dataset (``experiments/GraphCast/dataset.py:24-232``).
+Same policy here (this environment has no ogb package and zero egress; the
+OGB wrapper in ``dgraph_tpu.data.ogb`` gates on ogb availability).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def sbm_classification_graph(
+    num_nodes: int = 1000,
+    num_classes: int = 4,
+    feat_dim: int = 16,
+    avg_degree: float = 8.0,
+    homophily: float = 0.8,
+    train_frac: float = 0.6,
+    val_frac: float = 0.2,
+    seed: int = 0,
+):
+    """Stochastic-block-model node-classification task (Cora-like shape).
+
+    Features = class centroid + noise; edges mostly intra-class, so graph
+    aggregation is genuinely informative (a GCN beats an MLP).
+
+    Returns dict(edge_index [2,E], features [V,F], labels [V],
+    masks {train,val,test}).
+    """
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, num_nodes)
+    centroids = rng.normal(0, 1.0, (num_classes, feat_dim))
+    feats = centroids[labels] + rng.normal(0, 2.0, (num_nodes, feat_dim))
+
+    E = int(num_nodes * avg_degree // 2)
+    src = rng.integers(0, num_nodes, E * 3)
+    dst = rng.integers(0, num_nodes, E * 3)
+    same = labels[src] == labels[dst]
+    keep = np.where(same, rng.random(E * 3) < homophily, rng.random(E * 3) < (1 - homophily))
+    keep &= src != dst
+    src, dst = src[keep][:E], dst[keep][:E]
+    # symmetrize (the reference's OGB preprocessing does the same for arxiv)
+    edge_index = np.stack(
+        [np.concatenate([src, dst]), np.concatenate([dst, src])]
+    ).astype(np.int64)
+
+    order = rng.permutation(num_nodes)
+    n_tr = int(train_frac * num_nodes)
+    n_va = int(val_frac * num_nodes)
+    masks = {
+        "train": np.zeros(num_nodes, bool),
+        "val": np.zeros(num_nodes, bool),
+        "test": np.zeros(num_nodes, bool),
+    }
+    masks["train"][order[:n_tr]] = True
+    masks["val"][order[n_tr : n_tr + n_va]] = True
+    masks["test"][order[n_tr + n_va :]] = True
+    return {
+        "edge_index": edge_index,
+        "features": feats.astype(np.float32),
+        "labels": labels.astype(np.int32),
+        "masks": masks,
+        "num_classes": num_classes,
+    }
+
+
+def power_law_graph(num_nodes: int, avg_degree: float, seed: int = 0) -> np.ndarray:
+    """Degree-skewed random digraph (papers100M-like degree profile) —
+    endpoint sampling proportional to a Zipf-ish weight."""
+    rng = np.random.default_rng(seed)
+    E = int(num_nodes * avg_degree)
+    w = 1.0 / np.arange(1, num_nodes + 1) ** 0.75
+    w /= w.sum()
+    src = rng.choice(num_nodes, E, p=w)
+    dst = rng.integers(0, num_nodes, E)
+    return np.stack([src, dst]).astype(np.int64)

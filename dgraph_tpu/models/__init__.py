@@ -1,0 +1,16 @@
+from dgraph_tpu.models.mlp import MLP
+from dgraph_tpu.models.gcn import GraphConvLayer, GCN
+from dgraph_tpu.models.sage import SAGEConv, GraphSAGE
+from dgraph_tpu.models.gat import GATConv, GAT
+from dgraph_tpu.models.norm import DistributedBatchNorm
+
+__all__ = [
+    "MLP",
+    "GraphConvLayer",
+    "GCN",
+    "SAGEConv",
+    "GraphSAGE",
+    "GATConv",
+    "GAT",
+    "DistributedBatchNorm",
+]

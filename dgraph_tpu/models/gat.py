@@ -1,0 +1,82 @@
+"""Graph attention (GAT) with distributed softmax-over-incoming-edges.
+
+Reference parity: ``experiments/OGB-LSC/RGAT.py:127-268`` (CommAwareGAT).
+The reference, with src-owned edges, needs SIX comm ops per layer (gather
+h_i, gather h_j, scatter denominator, gather denominator, scatter messages,
+plus norm round-trips — ``RGAT.py:174-206``). With dst-owned edges (this
+framework's default) the attention softmax is a purely LOCAL segment
+operation on each shard — only the initial src-feature gather communicates.
+One collective per layer instead of six; same math.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.ops import local as local_ops
+from dgraph_tpu.plan import EdgePlan
+
+
+class GATConv(nn.Module):
+    out_features: int
+    comm: Any
+    num_heads: int = 1
+    negative_slope: float = 0.2
+    residual: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, plan: EdgePlan) -> jax.Array:
+        if plan.halo_side != "src":
+            raise ValueError(
+                "GATConv requires dst-owned edges (halo_side='src') so the "
+                "attention softmax is rank-local; build the plan with "
+                "edge_owner='dst'"
+            )
+        H, D = self.num_heads, self.out_features
+        w = nn.Dense(H * D, use_bias=False, name="proj")
+        hx = w(x).reshape(-1, H, D)  # [n_pad, H, D]
+
+        # per-edge endpoint features: src via halo gather, dst local
+        h_src = self.comm.gather(hx.reshape(-1, H * D), plan, side="src").reshape(
+            -1, H, D
+        )
+        h_dst = self.comm.gather(hx.reshape(-1, H * D), plan, side="dst").reshape(
+            -1, H, D
+        )
+
+        a_src = self.param("att_src", nn.initializers.glorot_uniform(), (H, D))
+        a_dst = self.param("att_dst", nn.initializers.glorot_uniform(), (H, D))
+        logits = (h_src * a_src).sum(-1) + (h_dst * a_dst).sum(-1)  # [e_pad, H]
+        logits = nn.leaky_relu(logits, self.negative_slope)
+
+        # local softmax over incoming edges of each dst vertex
+        alpha = local_ops.segment_softmax(
+            logits, plan.dst_index, plan.n_dst_pad, plan.edge_mask
+        )  # [e_pad, H]
+        msg = (alpha[..., None] * h_src).reshape(-1, H * D)
+        out = self.comm.scatter_sum(msg, plan, side="dst").reshape(-1, H, D)
+        out = out.mean(axis=1)  # head-mean (reference RGAT uses concat+proj; mean keeps D)
+        if self.residual:
+            out = out + nn.Dense(D, use_bias=False, name="res")(x)
+        return out
+
+
+class GAT(nn.Module):
+    hidden_features: int
+    out_features: int
+    comm: Any
+    num_layers: int = 2
+    num_heads: int = 4
+
+    @nn.compact
+    def __call__(self, x: jax.Array, plan: EdgePlan) -> jax.Array:
+        for _ in range(self.num_layers):
+            x = GATConv(self.hidden_features, comm=self.comm, num_heads=self.num_heads)(
+                x, plan
+            )
+            x = nn.elu(x)
+        return nn.Dense(self.out_features)(x)

@@ -1,0 +1,27 @@
+"""Plain MLP building block (GraphCast's MeshGraphMLP analogue,
+``experiments/GraphCast/layers.py:24-79``: hidden layers + optional
+LayerNorm on the output)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+
+
+class MLP(nn.Module):
+    features: Sequence[int]
+    activation: Callable = nn.silu
+    use_layer_norm: bool = False
+    dtype: Optional[object] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype)(x)
+            if i < len(self.features) - 1:
+                x = self.activation(x)
+        if self.use_layer_norm:
+            x = nn.LayerNorm(dtype=self.dtype)(x)
+        return x

@@ -1,0 +1,58 @@
+"""Distributed BatchNorm over vertex-sharded activations.
+
+Reference parity: ``experiments/OGB-LSC/distributed_layers.py:22-207``
+(DistributedBatchNorm1D): mean/var all-reduced across ranks with a custom
+fwd/bwd. In JAX the psum is differentiable, so no hand-written backward is
+needed; masking excludes padded vertices from the statistics (the reference
+has no padding so it divides by global count directly,
+``distributed_layers.py:29-68``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class DistributedBatchNorm(nn.Module):
+    comm: Any
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    use_running_average: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,  # [n_pad, F] per-shard
+        mask: Optional[jax.Array] = None,  # [n_pad] 1.0 for real vertices
+        use_running_average: Optional[bool] = None,
+    ) -> jax.Array:
+        use_ra = (
+            use_running_average
+            if use_running_average is not None
+            else self.use_running_average
+        )
+        F = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean", lambda: jnp.zeros(F))
+        ra_var = self.variable("batch_stats", "var", lambda: jnp.ones(F))
+        scale = self.param("scale", nn.initializers.ones, (F,))
+        bias = self.param("bias", nn.initializers.zeros, (F,))
+
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            if mask is None:
+                mask = jnp.ones(x.shape[0], x.dtype)
+            m = mask[:, None]
+            count = self.comm.all_reduce_sum(mask.sum())
+            mean = self.comm.all_reduce_sum((x * m).sum(0)) / jnp.maximum(count, 1.0)
+            var = self.comm.all_reduce_sum(((x - mean) ** 2 * m).sum(0)) / jnp.maximum(
+                count, 1.0
+            )
+            if not self.is_initializing():
+                ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
+        return scale * (x - mean) * jax.lax.rsqrt(var + self.epsilon) + bias

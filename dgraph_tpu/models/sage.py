@@ -1,0 +1,48 @@
+"""GraphSAGE (mean aggregator) — one of the reference's tracked configs
+(BASELINE.md: "ogbn-arxiv GraphSAGE (4-way)").
+
+SAGEConv: h_v = act(W_self x_v + W_nbr mean_{u->v} x_u). The neighbor mean is
+a distributed gather (src side, halo exchange) + local segment mean on the
+dst-owner side.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.plan import EdgePlan
+
+
+class SAGEConv(nn.Module):
+    out_features: int
+    comm: Any
+    activation: Any = nn.relu
+
+    @nn.compact
+    def __call__(self, x: jax.Array, plan: EdgePlan) -> jax.Array:
+        h_src = self.comm.gather(x, plan, side="src")  # [e_pad, F]
+        agg = self.comm.scatter_sum(h_src, plan, side="dst")  # [n_pad, F]
+        ones = plan.edge_mask[:, None]
+        deg = self.comm.scatter_sum(ones, plan, side="dst")  # [n_pad, 1]
+        mean_nbr = agg / jnp.maximum(deg, 1.0)
+        out = nn.Dense(self.out_features)(x) + nn.Dense(self.out_features, use_bias=False)(
+            mean_nbr
+        )
+        return self.activation(out)
+
+
+class GraphSAGE(nn.Module):
+    hidden_features: int
+    out_features: int
+    comm: Any
+    num_layers: int = 2
+
+    @nn.compact
+    def __call__(self, x: jax.Array, plan: EdgePlan) -> jax.Array:
+        for _ in range(self.num_layers):
+            x = SAGEConv(self.hidden_features, comm=self.comm)(x, plan)
+        return nn.Dense(self.out_features)(x)

@@ -1,0 +1,8 @@
+from dgraph_tpu.train.loop import (
+    TrainState,
+    make_train_step,
+    make_eval_step,
+    init_params,
+)
+
+__all__ = ["TrainState", "make_train_step", "make_eval_step", "init_params"]
