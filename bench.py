@@ -1,0 +1,111 @@
+"""Benchmark harness: full-graph GCN training epoch time at ogbn-arxiv scale.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The reference publishes no numbers (BASELINE.md), so vs_baseline is measured
+against OUR recorded round-1 number in BENCH_BASELINE.json when present
+(ratio > 1.0 = faster than the recorded baseline), else 1.0. The measured
+quantity mirrors the reference's OGB harness (per-epoch training time, avg
+excluding first/compile epoch — ``experiments/OGB/main.py:129-221``) on an
+arxiv-shaped synthetic graph (169k vertices / 2.3M directed edges, 128
+features, 40 classes — ogbn-arxiv's shape).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dgraph_tpu.comm import Communicator
+    from dgraph_tpu.data import DistributedGraph, synthetic
+    from dgraph_tpu.models import GCN
+
+    # ogbn-arxiv shape (V=169343, E~1.17M directed, symmetrized ~2.33M)
+    V, E_half, F, C = 169_343, 1_166_243, 128, 40
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, V, E_half)
+    dst = rng.integers(0, V, E_half)
+    edge_index = np.stack(
+        [np.concatenate([src, dst]), np.concatenate([dst, src])]
+    ).astype(np.int64)
+    feats = rng.normal(size=(V, F)).astype(np.float32)
+    labels = rng.integers(0, C, V).astype(np.int32)
+    masks = {"train": np.ones(V, bool)}
+
+    n_dev = len(jax.devices())
+    world = 1  # bench target is the single real TPU chip
+    g = DistributedGraph.from_global(
+        edge_index, feats, labels, masks, world_size=world,
+        partition_method="block", add_symmetric_norm=True, pad_multiple=128,
+    )
+
+    comm = Communicator.init_process_group("single")
+    model = GCN(hidden_features=256, out_features=C, comm=comm, num_layers=3)
+
+    plan = jax.tree.map(lambda leaf: jnp.asarray(leaf[0]), g.plan)
+    x = jnp.asarray(g.features[0])
+    y = jnp.asarray(g.labels[0])
+    mask = jnp.asarray(g.masks["train"][0])
+    ew = jnp.asarray(g.edge_weight[0])
+
+    params = model.init(jax.random.key(0), x, plan, ew)
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, x, y, mask, ew):
+        def lf(p):
+            logits = model.apply(p, x, plan, ew)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            ll = jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+            return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        loss, grads = jax.value_and_grad(lf)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # warmup/compile
+    params, opt_state, loss = train_step(params, opt_state, x, y, mask, ew)
+    jax.block_until_ready(loss)
+
+    n_iters = 20
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        params, opt_state, loss = train_step(params, opt_state, x, y, mask, ew)
+    jax.block_until_ready(loss)
+    dt_ms = (time.perf_counter() - t0) / n_iters * 1000.0
+
+    vs = 1.0
+    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    if os.path.exists(base_path):
+        try:
+            base = json.load(open(base_path))
+            if base.get("unit") == "ms" and base.get("value"):
+                vs = float(base["value"]) / dt_ms  # >1 = faster than baseline
+        except Exception:
+            pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "arxiv_gcn_epoch_time",
+                "value": round(dt_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -3,8 +3,12 @@ from dgraph_tpu.models.gcn import GraphConvLayer, GCN
 from dgraph_tpu.models.sage import SAGEConv, GraphSAGE
 from dgraph_tpu.models.gat import GATConv, GAT
 from dgraph_tpu.models.norm import DistributedBatchNorm
+from dgraph_tpu.models.rgat import RGAT, RGATLayer, RelationalAttention
 
 __all__ = [
+    "RGAT",
+    "RGATLayer",
+    "RelationalAttention",
     "MLP",
     "GraphConvLayer",
     "GCN",

@@ -20,7 +20,7 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from dgraph_tpu.comm.mesh import GRAPH_AXIS, plan_in_specs, squeeze_plan
+from dgraph_tpu.comm.mesh import GRAPH_AXIS, REPLICA_AXIS, plan_in_specs, squeeze_plan
 from dgraph_tpu.plan import EdgePlan
 
 
@@ -87,6 +87,12 @@ def make_train_step(
     ``DistributedGraph.batch`` + labels); params/opt_state are replicated.
     """
 
+    # replica-axis size (data parallelism): grads auto-psum over EVERY axis
+    # params are replicated on, so scale the loss by 1/num_replicas to turn
+    # the replica-sum into the DDP mean (graph-axis contributions are partial
+    # sums of one sample and must stay a sum).
+    num_replicas = dict(mesh.shape).get(REPLICA_AXIS, 1)
+
     def shard_body(params, batch, plan):
         plan = squeeze_plan(plan)
         b = jax.tree.map(lambda leaf: leaf[0], batch)
@@ -95,9 +101,9 @@ def make_train_step(
             logits = model.apply(p, *_batch_args(b, plan))
             loss = loss_fn(logits, b["y"], b["mask"], GRAPH_AXIS)
             correct = ((jnp.argmax(logits, -1) == b["y"]) * b["mask"]).sum()
-            return loss, correct
+            return loss / num_replicas, (loss, correct)
 
-        (loss, correct), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        (_, (loss, correct)), grads = jax.value_and_grad(lf, has_aux=True)(params)
         # NO explicit grad psum: params enter replicated (in_specs P()), and
         # shard_map's vma tracking makes grad-of-replicated-input insert the
         # cross-shard psum automatically (the transpose of the replicated
