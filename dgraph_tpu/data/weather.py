@@ -1,0 +1,64 @@
+"""Synthetic ERA5-like weather dataset for GraphCast training.
+
+Reference parity: ``experiments/GraphCast/dataset.py:24-232``
+(SyntheticWeatherDataset: random 721x1440x73-channel fields served as
+(input, target) steps, partitioned per rank) — with the §2.6-noted missing
+``mesh_vertex_placement`` constructor bug designed out (this dataset only
+needs the grid renumbering, taken directly from the built graphs).
+
+Fields are smooth (low-frequency Fourier mixtures) so one-step prediction is
+learnable; the target is a fixed deterministic advection/decay of the input,
+giving a non-trivial but stationary mapping.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dgraph_tpu.plan import shard_vertex_data
+
+
+class SyntheticWeatherDataset:
+    def __init__(
+        self,
+        graphs,  # GraphCastGraphs
+        num_lat: int,
+        num_lon: int,
+        num_channels: int = 73,
+        num_samples: int = 8,
+        seed: int = 0,
+    ):
+        self.num_lat, self.num_lon = num_lat, num_lon
+        self.num_channels = num_channels
+        self.graphs = graphs
+        rng = np.random.default_rng(seed)
+        n_grid = num_lat * num_lon
+
+        # smooth random fields: sum of a few random spatial harmonics / channel
+        lat = np.linspace(0, np.pi, num_lat)[:, None]
+        lon = np.linspace(0, 2 * np.pi, num_lon, endpoint=False)[None, :]
+        self._samples = []
+        for _ in range(num_samples):
+            fields = np.zeros((num_lat, num_lon, num_channels), np.float32)
+            for c in range(num_channels):
+                for _ in range(3):
+                    kl, kk = rng.integers(1, 4), rng.integers(1, 5)
+                    ph = rng.uniform(0, 2 * np.pi)
+                    amp = rng.normal(0, 1.0)
+                    fields[:, :, c] += amp * np.sin(kl * lat + ph) * np.cos(kk * lon)
+            x = fields.reshape(n_grid, num_channels)
+            # deterministic target: eastward roll + mild decay + channel mix
+            rolled = np.roll(fields, shift=3, axis=1).reshape(n_grid, num_channels)
+            y = 0.9 * rolled + 0.1 * x.mean(axis=1, keepdims=True)
+            self._samples.append((x.astype(np.float32), y.astype(np.float32)))
+
+    def __len__(self):
+        return len(self._samples)
+
+    def get_sharded(self, i: int):
+        """(input, target) as [W, n_grid_pad, C] plan-layout arrays."""
+        x, y = self._samples[i % len(self._samples)]
+        g = self.graphs
+        xs = shard_vertex_data(x[g.grid_ren.inv], g.grid_ren.counts, g.n_grid_pad)
+        ys = shard_vertex_data(y[g.grid_ren.inv], g.grid_ren.counts, g.n_grid_pad)
+        return xs, ys

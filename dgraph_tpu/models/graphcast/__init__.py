@@ -1,0 +1,14 @@
+from dgraph_tpu.models.graphcast.mesh import build_multimesh, icosahedron, MultiMesh
+from dgraph_tpu.models.graphcast.graph import GraphCastGraphs, build_graphcast_graphs
+from dgraph_tpu.models.graphcast.model import GraphCast, MeshEdgeBlock, MeshNodeBlock
+
+__all__ = [
+    "MultiMesh",
+    "icosahedron",
+    "build_multimesh",
+    "GraphCastGraphs",
+    "build_graphcast_graphs",
+    "GraphCast",
+    "MeshEdgeBlock",
+    "MeshNodeBlock",
+]

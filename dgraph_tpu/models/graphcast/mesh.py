@@ -1,0 +1,163 @@
+"""Icosahedral multimesh generation (pure numpy, written from scratch).
+
+Reference behavior parity: ``experiments/GraphCast/data_utils/icosahedral_mesh.py``
+(which vendors DeepMind's generator): repeatedly subdivide an icosahedron,
+keep vertices of level l as a prefix of level l+1's vertices, and form the
+MULTIMESH by merging the (bidirectional) edge sets of every level expressed
+in the finest level's vertex numbering.
+
+Structural anchors (asserted in tests, same constants as
+``experiments/GraphCast/tests/test_single_graph_data.py:20-34``):
+level 6 -> 40 962 vertices, 655 320 multimesh edges (= 2 * 30 * (4^7-1)/3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiMesh:
+    vertices: np.ndarray  # [V, 3] unit-sphere positions (finest level)
+    faces: np.ndarray  # [F, 3] finest-level triangles
+    edges: np.ndarray  # [2, E] multimesh edges, bidirectional, deduped
+    level: int
+
+
+def icosahedron() -> tuple[np.ndarray, np.ndarray]:
+    """Unit icosahedron: 12 vertices, 20 faces."""
+    phi = (1.0 + np.sqrt(5.0)) / 2.0
+    verts = np.array(
+        [
+            [-1, phi, 0], [1, phi, 0], [-1, -phi, 0], [1, -phi, 0],
+            [0, -1, phi], [0, 1, phi], [0, -1, -phi], [0, 1, -phi],
+            [phi, 0, -1], [phi, 0, 1], [-phi, 0, -1], [-phi, 0, 1],
+        ],
+        dtype=np.float64,
+    )
+    verts /= np.linalg.norm(verts, axis=1, keepdims=True)
+    faces = np.array(
+        [
+            [0, 11, 5], [0, 5, 1], [0, 1, 7], [0, 7, 10], [0, 10, 11],
+            [1, 5, 9], [5, 11, 4], [11, 10, 2], [10, 7, 6], [7, 1, 8],
+            [3, 9, 4], [3, 4, 2], [3, 2, 6], [3, 6, 8], [3, 8, 9],
+            [4, 9, 5], [2, 4, 11], [6, 2, 10], [8, 6, 7], [9, 8, 1],
+        ],
+        dtype=np.int64,
+    )
+    return verts, faces
+
+
+def subdivide(verts: np.ndarray, faces: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """One 4-to-1 triangle subdivision; parent vertices keep their indices,
+    midpoints are appended (prefix property the multimesh relies on)."""
+    edge_mid: dict[tuple[int, int], int] = {}
+    new_verts = [verts]
+    next_id = len(verts)
+    appended = []
+
+    def midpoint(a: int, b: int) -> int:
+        nonlocal next_id
+        key = (a, b) if a < b else (b, a)
+        if key not in edge_mid:
+            m = verts[a] + verts[b]
+            m /= np.linalg.norm(m)
+            appended.append(m)
+            edge_mid[key] = next_id
+            next_id += 1
+        return edge_mid[key]
+
+    new_faces = np.empty((len(faces) * 4, 3), dtype=np.int64)
+    for i, (a, b, c) in enumerate(faces):
+        ab, bc, ca = midpoint(a, b), midpoint(b, c), midpoint(c, a)
+        new_faces[4 * i + 0] = (a, ab, ca)
+        new_faces[4 * i + 1] = (b, bc, ab)
+        new_faces[4 * i + 2] = (c, ca, bc)
+        new_faces[4 * i + 3] = (ab, bc, ca)
+    all_verts = np.concatenate([verts, np.asarray(appended)], axis=0)
+    return all_verts, new_faces
+
+
+def faces_to_edges(faces: np.ndarray) -> np.ndarray:
+    """Bidirectional unique edge list [2, E] of a triangle mesh."""
+    e = np.concatenate(
+        [faces[:, [0, 1]], faces[:, [1, 2]], faces[:, [2, 0]]], axis=0
+    )
+    e = np.concatenate([e, e[:, ::-1]], axis=0)
+    e = np.unique(e, axis=0)
+    return e.T.copy()
+
+
+def build_multimesh(level: int) -> MultiMesh:
+    """All-level merged mesh: vertices of the finest level, union of every
+    level's bidirectional edges (the GraphCast 'multimesh')."""
+    verts, faces = icosahedron()
+    edge_sets = [faces_to_edges(faces)]
+    for _ in range(level):
+        verts, faces = subdivide(verts, faces)
+        edge_sets.append(faces_to_edges(faces))
+    edges = np.unique(np.concatenate(edge_sets, axis=1).T, axis=0).T.copy()
+    return MultiMesh(vertices=verts, faces=faces, edges=edges, level=level)
+
+
+def latlon_grid(num_lat: int, num_lon: int) -> tuple[np.ndarray, np.ndarray]:
+    """Equiangular lat-lon grid -> (latlon [N, 2] degrees, xyz [N, 3]).
+
+    Latitudes include both poles (721 rows = 0.25deg for ERA5, matching the
+    reference's 721x1440 grid, ``graphcast_config.py``); longitudes wrap.
+    Row-major (lat-major) flattening.
+    """
+    lats = np.linspace(90.0, -90.0, num_lat)
+    lons = np.linspace(0.0, 360.0, num_lon, endpoint=False)
+    lat_g, lon_g = np.meshgrid(lats, lons, indexing="ij")
+    latlon = np.stack([lat_g.ravel(), lon_g.ravel()], axis=1)
+    xyz = latlon_to_xyz(latlon)
+    return latlon, xyz
+
+
+def latlon_to_xyz(latlon: np.ndarray) -> np.ndarray:
+    lat = np.deg2rad(latlon[:, 0])
+    lon = np.deg2rad(latlon[:, 1])
+    return np.stack(
+        [np.cos(lat) * np.cos(lon), np.cos(lat) * np.sin(lon), np.sin(lat)], axis=1
+    )
+
+
+def grid2mesh_edges(
+    grid_xyz: np.ndarray, mesh: MultiMesh, radius_fraction: float = 0.6
+) -> np.ndarray:
+    """Connect each grid point to all mesh vertices within
+    ``radius_fraction * max_mesh_edge_length`` (the reference's 0.6 x max-edge
+    radius graph, ``data_utils/utils.py:148-187``). Returns [2, E] with
+    src=grid index, dst=mesh vertex index.
+    """
+    from scipy.spatial import cKDTree
+
+    edge_vec = mesh.vertices[mesh.edges[0]] - mesh.vertices[mesh.edges[1]]
+    max_len = np.linalg.norm(edge_vec, axis=1).max()
+    radius = radius_fraction * max_len
+    tree = cKDTree(mesh.vertices)
+    nbrs = tree.query_ball_point(grid_xyz, r=radius)
+    src = np.repeat(np.arange(len(grid_xyz)), [len(n) for n in nbrs])
+    dst = np.concatenate([np.asarray(n, dtype=np.int64) for n in nbrs])
+    return np.stack([src, dst]).astype(np.int64)
+
+
+def mesh2grid_edges(grid_xyz: np.ndarray, mesh: MultiMesh) -> np.ndarray:
+    """Connect each grid point to the 3 vertices of its nearest mesh face
+    (face found by 1-NN on face centroids — the reference's scheme,
+    ``data_utils/utils.py:112-145``). Returns [2, E] with src=mesh vertex,
+    dst=grid index; exactly 3 edges per grid point.
+    """
+    from scipy.spatial import cKDTree
+
+    centroids = mesh.vertices[mesh.faces].mean(axis=1)
+    centroids /= np.linalg.norm(centroids, axis=1, keepdims=True)
+    tree = cKDTree(centroids)
+    _, fidx = tree.query(grid_xyz, k=1)
+    tri = mesh.faces[fidx]  # [N, 3]
+    dst = np.repeat(np.arange(len(grid_xyz)), 3)
+    src = tri.ravel()
+    return np.stack([src, dst]).astype(np.int64)

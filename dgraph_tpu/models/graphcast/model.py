@@ -1,0 +1,113 @@
+"""GraphCast model: encode grid->mesh, process multimesh, decode mesh->grid.
+
+Reference parity: ``experiments/GraphCast/model.py`` — ``DGraphCast``
+(Embedder + Encoder + Processor(N layers) + Decoder + final MLP with residual
+grid prediction, ``model.py:311-394``) built from ``MeshGraphMLP`` /
+``MeshEdgeBlock`` / ``MeshNodeBlock`` (``layers.py:24-216``).
+
+Each EdgeBlock gathers both endpoint features (2 comm ops in the reference,
+``layers.py:182-216``; here only the src side communicates since edges are
+dst-owned) and each NodeBlock is a rank-local segment sum.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.models.mlp import MLP
+
+
+class MeshEdgeBlock(nn.Module):
+    """e' = e + MLP([e, h_src(gathered), h_dst(gathered)]) — layers.py:146-216."""
+
+    latent: int
+    comm: Any
+
+    @nn.compact
+    def __call__(self, e, x_src, x_dst, plan):
+        h_src = self.comm.gather(x_src, plan, side="src")
+        h_dst = self.comm.gather(x_dst, plan, side="dst")
+        upd = MLP([self.latent, self.latent], use_layer_norm=True)(
+            jnp.concatenate([e, h_src, h_dst], axis=-1)
+        )
+        return e + upd
+
+
+class MeshNodeBlock(nn.Module):
+    """x' = x + MLP([x, sum of incoming edge features]) — layers.py:82-143."""
+
+    latent: int
+    comm: Any
+
+    @nn.compact
+    def __call__(self, x_dst, e, plan):
+        agg = self.comm.scatter_sum(e, plan, side="dst")
+        upd = MLP([self.latent, self.latent], use_layer_norm=True)(
+            jnp.concatenate([x_dst, agg], axis=-1)
+        )
+        return x_dst + upd
+
+
+class GraphCast(nn.Module):
+    """Full model. Inputs are per-shard; statics come from
+    :class:`~dgraph_tpu.models.graphcast.graph.GraphCastGraphs`.
+
+    Args to __call__:
+      grid_feats: [n_grid_pad, C_in] dynamic grid state (weather channels).
+      statics: dict with grid_node_static / mesh_node_static /
+        {mesh,g2m,m2g}_edge_static per-shard arrays.
+      plans: dict with 'mesh', 'g2m', 'm2g' per-shard EdgePlans.
+    Returns [n_grid_pad, C_out] residual prediction added to the input
+    channels (``model.py:392-394``).
+    """
+
+    latent: int = 64
+    processor_layers: int = 4
+    out_channels: int = 73
+    comm: Any = None
+
+    @nn.compact
+    def __call__(self, grid_feats, statics, plans):
+        L = self.latent
+        # --- Embedder: 5 MLPs (model.py:79-105) ---
+        g = MLP([L, L], use_layer_norm=True, name="embed_grid")(
+            jnp.concatenate([grid_feats, statics["grid_node_static"]], axis=-1)
+        )
+        m = MLP([L, L], use_layer_norm=True, name="embed_mesh")(
+            statics["mesh_node_static"]
+        )
+        e_mesh = MLP([L, L], use_layer_norm=True, name="embed_mesh_edges")(
+            statics["mesh_edge_static"]
+        )
+        e_g2m = MLP([L, L], use_layer_norm=True, name="embed_g2m_edges")(
+            statics["g2m_edge_static"]
+        )
+        e_m2g = MLP([L, L], use_layer_norm=True, name="embed_m2g_edges")(
+            statics["m2g_edge_static"]
+        )
+
+        # --- Encoder: grid -> mesh (model.py:142-168) ---
+        e_g2m = MeshEdgeBlock(L, self.comm, name="enc_edge")(e_g2m, g, m, plans["g2m"])
+        m = MeshNodeBlock(L, self.comm, name="enc_node")(m, e_g2m, plans["g2m"])
+        g = g + MLP([L, L], use_layer_norm=True, name="enc_grid_mlp")(g)
+
+        # --- Processor: multimesh message passing (model.py:208-230) ---
+        for i in range(self.processor_layers):
+            e_mesh = MeshEdgeBlock(L, self.comm, name=f"proc_edge_{i}")(
+                e_mesh, m, m, plans["mesh"]
+            )
+            m = MeshNodeBlock(L, self.comm, name=f"proc_node_{i}")(
+                m, e_mesh, plans["mesh"]
+            )
+
+        # --- Decoder: mesh -> grid (model.py:268-308) ---
+        e_m2g = MeshEdgeBlock(L, self.comm, name="dec_edge")(e_m2g, m, g, plans["m2g"])
+        g = MeshNodeBlock(L, self.comm, name="dec_node")(g, e_m2g, plans["m2g"])
+
+        # --- prediction head: residual over input channels (model.py:392-394) ---
+        delta = MLP([L, self.out_channels], name="head")(g)
+        return grid_feats[..., : self.out_channels] + delta
