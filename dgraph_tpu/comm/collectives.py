@@ -135,7 +135,10 @@ def scatter_sum(
     idx = _side_index(plan, side)
     n_pad = _side_npad(plan, side)
     if side != plan.halo_side:
-        return local_ops.segment_sum(edata, idx, n_pad)
+        # owner-side aggregation: plan-sorted monotone segment ids
+        return local_ops.segment_sum(
+            edata, idx, n_pad, indices_are_sorted=plan.owner_sorted
+        )
     W = plan.world_size
     full = local_ops.segment_sum(edata, idx, n_pad + W * plan.halo.s_pad)
     local_part = full[:n_pad]

@@ -33,12 +33,24 @@ def masked_gather(src: jax.Array, idx: jax.Array, mask: jax.Array) -> jax.Array:
     return src[idx] * mask[..., None]
 
 
-def segment_sum(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+def segment_sum(
+    data: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    indices_are_sorted: bool = False,
+) -> jax.Array:
     """Sum rows of ``data`` into ``num_segments`` buckets by ``segment_ids``.
 
     The TPU replacement for atomicAdd scatter (``local_data_kernels.cuh:208-253``).
+    ``indices_are_sorted=True`` (plan-guaranteed when
+    ``EdgePlan.owner_sorted``) lets XLA use the cheaper monotone-scatter path.
     """
-    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+    return jax.ops.segment_sum(
+        data,
+        segment_ids,
+        num_segments=num_segments,
+        indices_are_sorted=indices_are_sorted,
+    )
 
 
 def segment_max(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:

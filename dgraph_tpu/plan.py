@@ -278,7 +278,15 @@ class HaloSpec:
 
 
 @pytree_dataclass(
-    static=("world_size", "n_src_pad", "n_dst_pad", "e_pad", "halo_side", "homogeneous")
+    static=(
+        "world_size",
+        "n_src_pad",
+        "n_dst_pad",
+        "e_pad",
+        "halo_side",
+        "homogeneous",
+        "owner_sorted",
+    )
 )
 class EdgePlan:
     """Padded, static-shape plan for one edge set (relation), stacked over ranks.
@@ -311,6 +319,12 @@ class EdgePlan:
     e_pad: int
     halo_side: str  # 'src' or 'dst'
     homogeneous: bool
+    # True when each rank's edges are sorted by the owner-side vertex index:
+    # aggregation segment-ids are then monotone, enabling
+    # indices_are_sorted segment reductions and sorted-CSR Pallas kernels
+    # (the analogue of the sorted/deduped order the reference's plan build
+    # establishes for its alltoallv path, _NCCLCommPlan.py:221-226)
+    owner_sorted: bool = True
 
 
 @dataclasses.dataclass
@@ -349,6 +363,7 @@ def build_edge_plan(
     e_pad: Optional[int] = None,
     s_pad: Optional[int] = None,
     pad_multiple: int = 8,
+    sort_edges: bool = True,
 ) -> tuple[EdgePlan, EdgePlanLayout]:
     """Build the padded SPMD plan for one edge set.
 
@@ -395,8 +410,13 @@ def build_edge_plan(
     else:
         raise ValueError("edge_owner must be 'src' or 'dst'")
 
-    # --- group edges by owner rank (stable: preserves original order) ---
-    order = np.argsort(owner, kind="stable")
+    # --- group edges by owner rank; optionally sort by owner-side vertex
+    # within each rank so aggregation segment ids are monotone ---
+    owner_side_vid = dst if edge_owner == "dst" else src
+    if sort_edges:
+        order = np.lexsort((owner_side_vid, owner))
+    else:
+        order = np.argsort(owner, kind="stable")
     e_counts = np.bincount(owner, minlength=W).astype(np.int64)
     E_pad = e_pad if e_pad is not None else _pad_to(int(e_counts.max(initial=1)), pad_multiple)
     if int(e_counts.max(initial=0)) > E_pad:
@@ -505,6 +525,7 @@ def build_edge_plan(
         e_pad=E_pad,
         halo_side=halo_side,
         homogeneous=homogeneous,
+        owner_sorted=sort_edges,
     )
     layout = EdgePlanLayout(
         edge_rank=edge_rank,

@@ -1,0 +1,100 @@
+"""Checkpoint / resume + plan caching.
+
+The reference checkpoints only model state_dicts with no optimizer/step state
+and no resume path (``train_graphcast.py:150-151``, SURVEY §5); its important
+persisted artifacts are preprocessing caches (partitioned graphs, per-rank
+comm plans — ``distributed_graph_dataset.py:399-422``,
+``ogbn_datasets.py:96-123``). This module provides both, better:
+
+- full train-state checkpointing (params + opt_state + step) via orbax,
+  with resume;
+- a plan cache keyed by (graph content hash, world_size, edge_owner,
+  pad_multiple) — the reference keys synthetic caches by config hash the
+  same way (``synthetic_dataset.py:180-196``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+
+
+# --- train state checkpointing (orbax) ---
+
+
+def save_checkpoint(ckpt_dir: str, state: dict, step: int) -> None:
+    """Save a pytree (e.g. {'params':…, 'opt_state':…, 'step':…})."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.join(ckpt_dir, f"step_{step:08d}"))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, state, force=True)
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [
+        int(d.split("_")[1])
+        for d in os.listdir(ckpt_dir)
+        if d.startswith("step_") and d.split("_")[1].isdigit()
+    ]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, template: dict, step: Optional[int] = None) -> Optional[dict]:
+    """Restore the given (or latest) step into template's structure; None if
+    no checkpoint exists."""
+    import orbax.checkpoint as ocp
+
+    step = step if step is not None else latest_step(ckpt_dir)
+    if step is None:
+        return None
+    path = os.path.abspath(os.path.join(ckpt_dir, f"step_{step:08d}"))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(path, item=template)
+
+
+# --- plan cache ---
+
+
+def _graph_fingerprint(edge_index: np.ndarray, partition: np.ndarray, **kw) -> str:
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(edge_index).tobytes())
+    h.update(np.ascontiguousarray(partition).tobytes())
+    h.update(repr(sorted(kw.items())).encode())
+    return h.hexdigest()[:24]
+
+
+def cached_edge_plan(
+    cache_dir: str,
+    edge_index: np.ndarray,
+    src_partition: np.ndarray,
+    dst_partition: Optional[np.ndarray] = None,
+    **build_kwargs: Any,
+):
+    """build_edge_plan with an on-disk cache (pickle of the numpy plan).
+
+    Parity: `_save_comm_plans`/`_load_comm_plans`
+    (``distributed_graph_dataset.py:399-422``).
+    """
+    from dgraph_tpu.plan import build_edge_plan
+
+    os.makedirs(cache_dir, exist_ok=True)
+    key = _graph_fingerprint(
+        edge_index,
+        src_partition if dst_partition is None else np.concatenate([src_partition, dst_partition]),
+        **{k: v for k, v in build_kwargs.items() if np.isscalar(v) or isinstance(v, str)},
+    )
+    path = os.path.join(cache_dir, f"plan_{key}.pkl")
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    result = build_edge_plan(edge_index, src_partition, dst_partition, **build_kwargs)
+    with open(path, "wb") as f:
+        pickle.dump(result, f)
+    return result

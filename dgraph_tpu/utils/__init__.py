@@ -1,0 +1,5 @@
+from dgraph_tpu.utils.timing import TimingReport
+from dgraph_tpu.utils.logging import ExperimentLog
+from dgraph_tpu.utils.data_splitting import largest_split, split_per_rank
+
+__all__ = ["TimingReport", "ExperimentLog", "largest_split", "split_per_rank"]

@@ -1,0 +1,15 @@
+"""Uneven split helpers — parity with ``DGraph/utils.py:17-26``
+(largest_split / split_per_rank)."""
+
+from __future__ import annotations
+
+
+def largest_split(total: int, world_size: int) -> int:
+    """ceil(total / world_size): the padded per-rank size."""
+    return -(-total // world_size)
+
+
+def split_per_rank(total: int, rank: int, world_size: int) -> int:
+    """Size of rank's slice under ceil-split (last rank may be short)."""
+    per = largest_split(total, world_size)
+    return max(0, min(per, total - rank * per))

@@ -1,0 +1,44 @@
+"""Experiment logging.
+
+Reference parity: ``experiments/OGB/utils.py:12-49`` (rank-0-only
+append-to-file experiment logs, ephemeral progress printing, trajectory
+plots). On TPU a single controller process drives all devices, so "rank 0
+only" is the default reality; the multi-controller case
+(``jax.process_index() == 0``) is still honored.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import jax
+
+
+def is_lead_process() -> bool:
+    return jax.process_index() == 0
+
+
+class ExperimentLog:
+    def __init__(self, path: str, echo: bool = True):
+        self.path = path
+        self.echo = echo
+        if is_lead_process():
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(self.path, "a") as f:
+                f.write(f"# log opened {time.strftime('%Y-%m-%d %H:%M:%S')}\n")
+
+    def write(self, record: dict) -> None:
+        if not is_lead_process():
+            return
+        line = json.dumps(record)
+        with open(self.path, "a") as f:
+            f.write(line + "\n")
+        if self.echo:
+            print(line, flush=True)
+
+    def progress(self, msg: str) -> None:
+        if is_lead_process():
+            print(msg, end="\r", flush=True)
