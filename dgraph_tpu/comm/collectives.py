@@ -136,6 +136,18 @@ def scatter_sum(
     n_pad = _side_npad(plan, side)
     if side != plan.halo_side:
         # owner-side aggregation: plan-sorted monotone segment ids
+        from dgraph_tpu import config as _cfg
+
+        if (
+            _cfg.use_pallas_scatter
+            and plan.owner_sorted
+            and jax.default_backend() == "tpu"
+        ):
+            from dgraph_tpu.ops.pallas_segment import sorted_segment_sum
+
+            return sorted_segment_sum(
+                edata, idx, n_pad, max_chunks_per_block=plan.scatter_mc
+            )
         return local_ops.segment_sum(
             edata, idx, n_pad, indices_are_sorted=plan.owner_sorted
         )

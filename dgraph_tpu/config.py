@@ -1,0 +1,34 @@
+"""Framework flags — one place, env-overridable.
+
+Replaces the reference's scattered env-var flags
+(``DGRAPH_CLEAR_BUFFER_CACHE``, ``RGAT_DDP_FIND_UNUSED``,
+``DISABLE_DGRAPH_NVSHMEM``, … — SURVEY.md §5 config) with a single module.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _env_flag(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+# Use the Pallas sorted-segment-sum kernel for owner-side scatter on TPU
+# (requires plan.owner_sorted; falls back to jnp segment_sum elsewhere).
+use_pallas_scatter: bool = _env_flag("DGRAPH_TPU_PALLAS_SCATTER", False)
+
+# Compute dtype for model matmuls (bfloat16 keeps the MXU fed; params stay
+# float32). Models read this at construction time.
+default_compute_dtype: str = os.environ.get("DGRAPH_TPU_COMPUTE_DTYPE", "float32")
+
+
+def set_flags(**kw) -> None:
+    g = globals()
+    for k, v in kw.items():
+        if k not in g:
+            raise KeyError(f"unknown dgraph_tpu.config flag: {k}")
+        g[k] = v

@@ -286,6 +286,7 @@ class HaloSpec:
         "halo_side",
         "homogeneous",
         "owner_sorted",
+        "scatter_mc",
     )
 )
 class EdgePlan:
@@ -323,8 +324,13 @@ class EdgePlan:
     # aggregation segment-ids are then monotone, enabling
     # indices_are_sorted segment reductions and sorted-CSR Pallas kernels
     # (the analogue of the sorted/deduped order the reference's plan build
-    # establishes for its alltoallv path, _NCCLCommPlan.py:221-226)
+    # establishes for its alltoallv path, _NCCLCommPlan.py:221-226).
+    # Padded edge slots carry the out-of-range owner-side id n_pad (monotone
+    # tail; dropped by scatter, clamped-and-masked by gather).
     owner_sorted: bool = True
+    # Pallas scheduling hint: max edge-chunks any (block_n=256) vertex block
+    # spans at block_e=256, maxed over shards (see ops.pallas_segment)
+    scatter_mc: int = 1
 
 
 @dataclasses.dataclass
@@ -497,19 +503,32 @@ def build_edge_plan(
     halo_side_local_idx = np.where(halo_is_local, local_halo_side, remote_slot)
 
     # --- scatter into padded [W, E_pad] layout ---
-    def to_padded(vals, dtype):
-        out = np.zeros((W, E_pad), dtype=dtype)
+    def to_padded(vals, dtype, fill=0):
+        out = np.full((W, E_pad), fill, dtype=dtype)
         out[edge_rank, edge_slot] = vals
         return out
 
     edge_mask = np.zeros((W, E_pad), dtype=np.float32)
     edge_mask[edge_rank, edge_slot] = 1.0
+    n_owner_pad = N_dst_pad if edge_owner == "dst" else N_src_pad
+    # owner-side padding = n_pad: keeps sorted order monotone through the
+    # padded tail and is dropped by segment reductions
     if halo_side == "src":
         src_idx_arr = to_padded(halo_side_local_idx.astype(np.int32), np.int32)
-        dst_idx_arr = to_padded(own_local.astype(np.int32), np.int32)
+        dst_idx_arr = to_padded(own_local.astype(np.int32), np.int32, fill=n_owner_pad)
     else:
-        src_idx_arr = to_padded(own_local.astype(np.int32), np.int32)
+        src_idx_arr = to_padded(own_local.astype(np.int32), np.int32, fill=n_owner_pad)
         dst_idx_arr = to_padded(halo_side_local_idx.astype(np.int32), np.int32)
+
+    owner_idx_arr = dst_idx_arr if edge_owner == "dst" else src_idx_arr
+    if sort_edges:
+        from dgraph_tpu.ops.pallas_segment import max_chunks_hint
+
+        scatter_mc = max(
+            max_chunks_hint(owner_idx_arr[r], n_owner_pad) for r in range(W)
+        )
+    else:
+        scatter_mc = 1
 
     plan = EdgePlan(
         src_index=src_idx_arr,
@@ -526,6 +545,7 @@ def build_edge_plan(
         halo_side=halo_side,
         homogeneous=homogeneous,
         owner_sorted=sort_edges,
+        scatter_mc=scatter_mc,
     )
     layout = EdgePlanLayout(
         edge_rank=edge_rank,
