@@ -1,0 +1,25 @@
+"""LR schedules.
+
+Reference parity: GraphCast's 3-phase schedule — linear warmup, cosine decay
+to a floor, then constant (``experiments/GraphCast/train_graphcast.py:82-103``).
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def graphcast_three_phase(
+    peak_lr: float = 1e-3,
+    warmup_steps: int = 1000,
+    decay_steps: int = 100_000,
+    floor_lr: float = 3e-7,
+) -> optax.Schedule:
+    return optax.join_schedules(
+        [
+            optax.linear_schedule(0.0, peak_lr, warmup_steps),
+            optax.cosine_decay_schedule(peak_lr, decay_steps, alpha=floor_lr / peak_lr),
+            optax.constant_schedule(floor_lr),
+        ],
+        boundaries=[warmup_steps, warmup_steps + decay_steps],
+    )
