@@ -1,0 +1,128 @@
+// Native host-side graph toolkit for dgraph_tpu.
+//
+// Role: the TPU-native counterpart of the reference's native layer. The
+// reference's C++/CUDA lives in the device path
+// (DGraph/distributed/csrc/*: gather/scatter kernels, NVSHMEM runtime); on
+// TPU the device path is XLA/Pallas, so native code belongs where Python is
+// actually the bottleneck: HOST-side plan building and partitioning of
+// billion-edge graphs (SURVEY.md §7 "papers100M plan build memory/time").
+//
+// Exposed via a plain C ABI and loaded with ctypes (no pybind11 in this
+// environment). Every entry point has a numpy fallback in
+// dgraph_tpu/partition.py / plan.py — the reference's dual
+// native/fallback pattern (RankLocalOps.py:21-31).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Build an undirected CSR adjacency from a directed edge list.
+// indptr must hold V+1 entries; if indices == nullptr, only fills indptr
+// (call once to size, once to fill).
+void build_sym_csr(const int64_t* src, const int64_t* dst, int64_t num_edges,
+                   int64_t num_vertices, int64_t* indptr, int64_t* indices) {
+  std::vector<int64_t> deg(num_vertices, 0);
+  for (int64_t e = 0; e < num_edges; ++e) {
+    ++deg[src[e]];
+    ++deg[dst[e]];
+  }
+  indptr[0] = 0;
+  for (int64_t v = 0; v < num_vertices; ++v) indptr[v + 1] = indptr[v] + deg[v];
+  if (!indices) return;
+  std::vector<int64_t> cur(indptr, indptr + num_vertices);
+  for (int64_t e = 0; e < num_edges; ++e) {
+    indices[cur[src[e]]++] = dst[e];
+    indices[cur[dst[e]]++] = src[e];
+  }
+}
+
+// Greedy BFS region-growing partition with hard balance cap — the METIS
+// substitute for very large graphs. Deterministic for a fixed seed.
+void greedy_bfs_partition(const int64_t* src, const int64_t* dst,
+                          int64_t num_edges, int64_t num_vertices,
+                          int32_t world_size, uint64_t seed, int32_t* out_part) {
+  std::vector<int64_t> indptr(num_vertices + 1);
+  std::vector<int64_t> indices;
+  build_sym_csr(src, dst, num_edges, num_vertices, indptr.data(), nullptr);
+  indices.resize(indptr[num_vertices]);
+  build_sym_csr(src, dst, num_edges, num_vertices, indptr.data(), indices.data());
+
+  std::fill(out_part, out_part + num_vertices, -1);
+  std::vector<int64_t> order(num_vertices);
+  for (int64_t i = 0; i < num_vertices; ++i) order[i] = i;
+  std::mt19937_64 rng(seed);
+  std::shuffle(order.begin(), order.end(), rng);
+
+  const int64_t cap = (num_vertices + world_size - 1) / world_size;
+  int64_t seed_ptr = 0;
+  std::vector<int64_t> stack;
+  stack.reserve(1024);
+  for (int32_t r = 0; r < world_size; ++r) {
+    int64_t count = 0;
+    stack.clear();
+    while (count < cap) {
+      if (stack.empty()) {
+        while (seed_ptr < num_vertices && out_part[order[seed_ptr]] >= 0) ++seed_ptr;
+        if (seed_ptr >= num_vertices) break;
+        stack.push_back(order[seed_ptr]);
+      }
+      int64_t v = stack.back();
+      stack.pop_back();
+      if (out_part[v] >= 0) continue;
+      out_part[v] = r;
+      ++count;
+      for (int64_t k = indptr[v]; k < indptr[v + 1]; ++k) {
+        int64_t n = indices[k];
+        if (out_part[n] < 0) stack.push_back(n);
+      }
+    }
+  }
+  for (int64_t v = 0; v < num_vertices; ++v)
+    if (out_part[v] < 0) out_part[v] = world_size - 1;
+}
+
+// Deduplicate (key, value) pairs encoded as key*stride+value, sorted.
+// Returns the number of unique pairs written to out (caller allocates n).
+int64_t unique_encoded_pairs(const int64_t* keys, const int64_t* vals,
+                             int64_t n, int64_t stride, int64_t* out) {
+  std::vector<int64_t> enc(n);
+  for (int64_t i = 0; i < n; ++i) enc[i] = keys[i] * stride + vals[i];
+  std::sort(enc.begin(), enc.end());
+  auto end = std::unique(enc.begin(), enc.end());
+  int64_t m = static_cast<int64_t>(end - enc.begin());
+  std::memcpy(out, enc.data(), m * sizeof(int64_t));
+  return m;
+}
+
+// Multi-threaded edge-cut count (partition quality metric at scale).
+int64_t edge_cut_count(const int64_t* src, const int64_t* dst, int64_t num_edges,
+                       const int32_t* part) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int num_threads = hw ? static_cast<int>(hw) : 4;
+  if (num_edges < (1 << 16)) num_threads = 1;
+  std::vector<int64_t> partial(num_threads, 0);
+  std::vector<std::thread> threads;
+  int64_t chunk = (num_edges + num_threads - 1) / num_threads;
+  for (int t = 0; t < num_threads; ++t) {
+    threads.emplace_back([&, t]() {
+      int64_t lo = t * chunk, hi = std::min<int64_t>(num_edges, lo + chunk);
+      int64_t c = 0;
+      for (int64_t e = lo; e < hi; ++e)
+        if (part[src[e]] != part[dst[e]]) ++c;
+      partial[t] = c;
+    });
+  }
+  for (auto& th : threads) th.join();
+  int64_t total = 0;
+  for (auto c : partial) total += c;
+  return total;
+}
+
+}  // extern "C"

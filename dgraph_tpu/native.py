@@ -1,0 +1,98 @@
+"""ctypes loader for the native host toolkit (csrc/dgraph_host.cpp).
+
+Builds the shared library on first use (g++, no pybind11 — SURVEY
+environment constraints) and exposes numpy-friendly wrappers. Every caller
+keeps a pure-numpy fallback; ``available()`` gates the dispatch — the
+reference's CUDA-or-torch dual-implementation pattern
+(``RankLocalOps.py:21-31``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "csrc")
+_SO = os.path.join(_CSRC, "build", "libdgraph_host.so")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _load():
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_SO):
+            try:
+                subprocess.run(
+                    ["make", "-C", _CSRC],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _build_failed = True
+            return None
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.greedy_bfs_partition.argtypes = [
+            i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_uint64, i32p,
+        ]
+        lib.greedy_bfs_partition.restype = None
+        lib.unique_encoded_pairs.argtypes = [
+            i64p, i64p, ctypes.c_int64, ctypes.c_int64, i64p,
+        ]
+        lib.unique_encoded_pairs.restype = ctypes.c_int64
+        lib.edge_cut_count.argtypes = [i64p, i64p, ctypes.c_int64, i32p]
+        lib.edge_cut_count.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def greedy_bfs_partition(
+    edge_index: np.ndarray, num_nodes: int, world_size: int, seed: int = 0
+) -> np.ndarray:
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    src = np.ascontiguousarray(edge_index[0], np.int64)
+    dst = np.ascontiguousarray(edge_index[1], np.int64)
+    out = np.empty(num_nodes, np.int32)
+    lib.greedy_bfs_partition(src, dst, len(src), num_nodes, world_size, seed, out)
+    return out
+
+
+def unique_encoded_pairs(keys: np.ndarray, vals: np.ndarray, stride: int) -> np.ndarray:
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    keys = np.ascontiguousarray(keys, np.int64)
+    vals = np.ascontiguousarray(vals, np.int64)
+    out = np.empty(len(keys), np.int64)
+    m = lib.unique_encoded_pairs(keys, vals, len(keys), stride, out)
+    return out[:m]
+
+
+def edge_cut_count(edge_index: np.ndarray, partition: np.ndarray) -> int:
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    src = np.ascontiguousarray(edge_index[0], np.int64)
+    dst = np.ascontiguousarray(edge_index[1], np.int64)
+    part = np.ascontiguousarray(partition, np.int32)
+    return int(lib.edge_cut_count(src, dst, len(src), part))

@@ -79,8 +79,14 @@ def greedy_bfs_partition(
 
     Grows each partition from an unassigned seed vertex by BFS until it holds
     ceil(n/w) vertices, then moves to the next partition. Cheap, deterministic,
-    and cut-quality between round-robin and METIS.
+    and cut-quality between round-robin and METIS. Dispatches to the native
+    C++ implementation (csrc/dgraph_host.cpp) when built — the python loop
+    below is the fallback-and-oracle.
     """
+    from dgraph_tpu import native
+
+    if native.available():
+        return native.greedy_bfs_partition(edge_index, num_nodes, world_size, seed)
     from scipy.sparse import coo_matrix
 
     src, dst = np.asarray(edge_index[0]), np.asarray(edge_index[1])

@@ -437,8 +437,13 @@ def build_edge_plan(
     # --- halo sets: unique (needer_rank, halo_vertex) pairs of cross edges ---
     cross = halo_part[halo_vid] != owner
     v_total = len(halo_part)
-    enc = owner[cross].astype(np.int64) * v_total + halo_vid[cross]
-    enc_u = np.unique(enc)  # sorted by (needer, vid); vid sorted == owner-grouped
+    from dgraph_tpu import native as _native
+
+    if _native.available() and cross.sum() > (1 << 16):
+        enc_u = _native.unique_encoded_pairs(owner[cross], halo_vid[cross], v_total)
+    else:
+        enc = owner[cross].astype(np.int64) * v_total + halo_vid[cross]
+        enc_u = np.unique(enc)  # sorted by (needer, vid); vid-sorted == owner-grouped
     needer = enc_u // v_total
     hvid = enc_u % v_total
     sender = halo_part[hvid]
