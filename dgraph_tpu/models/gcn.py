@@ -37,6 +37,7 @@ class GraphConvLayer(nn.Module):
     comm: Any  # _BaseComm (static dataclass)
     aggregate_to: str = "dst"
     activation: Any = nn.relu
+    dtype: Any = None  # compute dtype (e.g. jnp.bfloat16); params stay f32
 
     @nn.compact
     def __call__(
@@ -50,8 +51,8 @@ class GraphConvLayer(nn.Module):
         # and gather the projected D-dim rows — instead of materializing the
         # [E, 2F] concat the reference builds per edge (GCN.py:34-67). Saves
         # ~(E/N)x matmul FLOPs and the [E,2F] HBM round trip; exact same math.
-        h_s = nn.Dense(self.out_features, name="src_proj")(x)
-        h_d = nn.Dense(self.out_features, use_bias=False, name="dst_proj")(x)
+        h_s = nn.Dense(self.out_features, name="src_proj", dtype=self.dtype)(x)
+        h_d = nn.Dense(self.out_features, use_bias=False, name="dst_proj", dtype=self.dtype)(x)
         m = self.comm.gather(h_s, plan, side="src") + self.comm.gather(
             h_d, plan, side="dst"
         )
@@ -70,6 +71,7 @@ class GCN(nn.Module):
     num_layers: int = 2
     aggregate_to: str = "dst"
     dropout_rate: float = 0.0
+    dtype: Any = None
 
     @nn.compact
     def __call__(
@@ -81,8 +83,11 @@ class GCN(nn.Module):
     ) -> jax.Array:
         for _ in range(self.num_layers):
             x = GraphConvLayer(
-                self.hidden_features, comm=self.comm, aggregate_to=self.aggregate_to
+                self.hidden_features,
+                comm=self.comm,
+                aggregate_to=self.aggregate_to,
+                dtype=self.dtype,
             )(x, plan, edge_weight)
             if self.dropout_rate > 0:
                 x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
-        return nn.Dense(self.out_features)(x)
+        return nn.Dense(self.out_features, dtype=self.dtype)(x).astype(jnp.float32)

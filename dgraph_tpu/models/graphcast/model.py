@@ -26,12 +26,13 @@ class MeshEdgeBlock(nn.Module):
 
     latent: int
     comm: Any
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, e, x_src, x_dst, plan):
         h_src = self.comm.gather(x_src, plan, side="src")
         h_dst = self.comm.gather(x_dst, plan, side="dst")
-        upd = MLP([self.latent, self.latent], use_layer_norm=True)(
+        upd = MLP([self.latent, self.latent], use_layer_norm=True, dtype=self.dtype)(
             jnp.concatenate([e, h_src, h_dst], axis=-1)
         )
         return e + upd
@@ -42,11 +43,12 @@ class MeshNodeBlock(nn.Module):
 
     latent: int
     comm: Any
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x_dst, e, plan):
         agg = self.comm.scatter_sum(e, plan, side="dst")
-        upd = MLP([self.latent, self.latent], use_layer_norm=True)(
+        upd = MLP([self.latent, self.latent], use_layer_norm=True, dtype=self.dtype)(
             jnp.concatenate([x_dst, agg], axis=-1)
         )
         return x_dst + upd
@@ -69,45 +71,46 @@ class GraphCast(nn.Module):
     processor_layers: int = 4
     out_channels: int = 73
     comm: Any = None
+    dtype: Any = None  # compute dtype (bfloat16 recommended on TPU)
 
     @nn.compact
     def __call__(self, grid_feats, statics, plans):
         L = self.latent
         # --- Embedder: 5 MLPs (model.py:79-105) ---
-        g = MLP([L, L], use_layer_norm=True, name="embed_grid")(
+        g = MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_grid")(
             jnp.concatenate([grid_feats, statics["grid_node_static"]], axis=-1)
         )
-        m = MLP([L, L], use_layer_norm=True, name="embed_mesh")(
+        m = MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_mesh")(
             statics["mesh_node_static"]
         )
-        e_mesh = MLP([L, L], use_layer_norm=True, name="embed_mesh_edges")(
+        e_mesh = MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_mesh_edges")(
             statics["mesh_edge_static"]
         )
-        e_g2m = MLP([L, L], use_layer_norm=True, name="embed_g2m_edges")(
+        e_g2m = MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_g2m_edges")(
             statics["g2m_edge_static"]
         )
-        e_m2g = MLP([L, L], use_layer_norm=True, name="embed_m2g_edges")(
+        e_m2g = MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_m2g_edges")(
             statics["m2g_edge_static"]
         )
 
         # --- Encoder: grid -> mesh (model.py:142-168) ---
-        e_g2m = MeshEdgeBlock(L, self.comm, name="enc_edge")(e_g2m, g, m, plans["g2m"])
-        m = MeshNodeBlock(L, self.comm, name="enc_node")(m, e_g2m, plans["g2m"])
-        g = g + MLP([L, L], use_layer_norm=True, name="enc_grid_mlp")(g)
+        e_g2m = MeshEdgeBlock(L, self.comm, dtype=self.dtype, name="enc_edge")(e_g2m, g, m, plans["g2m"])
+        m = MeshNodeBlock(L, self.comm, dtype=self.dtype, name="enc_node")(m, e_g2m, plans["g2m"])
+        g = g + MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="enc_grid_mlp")(g)
 
         # --- Processor: multimesh message passing (model.py:208-230) ---
         for i in range(self.processor_layers):
-            e_mesh = MeshEdgeBlock(L, self.comm, name=f"proc_edge_{i}")(
+            e_mesh = MeshEdgeBlock(L, self.comm, dtype=self.dtype, name=f"proc_edge_{i}")(
                 e_mesh, m, m, plans["mesh"]
             )
-            m = MeshNodeBlock(L, self.comm, name=f"proc_node_{i}")(
+            m = MeshNodeBlock(L, self.comm, dtype=self.dtype, name=f"proc_node_{i}")(
                 m, e_mesh, plans["mesh"]
             )
 
         # --- Decoder: mesh -> grid (model.py:268-308) ---
-        e_m2g = MeshEdgeBlock(L, self.comm, name="dec_edge")(e_m2g, m, g, plans["m2g"])
-        g = MeshNodeBlock(L, self.comm, name="dec_node")(g, e_m2g, plans["m2g"])
+        e_m2g = MeshEdgeBlock(L, self.comm, dtype=self.dtype, name="dec_edge")(e_m2g, m, g, plans["m2g"])
+        g = MeshNodeBlock(L, self.comm, dtype=self.dtype, name="dec_node")(g, e_m2g, plans["m2g"])
 
         # --- prediction head: residual over input channels (model.py:392-394) ---
-        delta = MLP([L, self.out_channels], name="head")(g)
-        return grid_feats[..., : self.out_channels] + delta
+        delta = MLP([L, self.out_channels], dtype=self.dtype, name="head")(g)
+        return grid_feats[..., : self.out_channels] + delta.astype(jnp.float32)

@@ -1,0 +1,38 @@
+"""Generic message-passing wrapper over halo exchange.
+
+Reference parity: ``DGraph/distributed/haloExchange.py:142-223``
+(``DGraphMessagePassing``: halo-exchange -> concat(local, halo) -> user
+message-passing layer). The TPU version exposes the same two-step shape —
+exchange then a user function over the concatenated buffer — so layers
+written against the reference's API have a direct home. New code should
+usually prefer the plan-based :meth:`comm.gather`/:meth:`comm.scatter_sum`
+(one fused pipeline, no materialized halo concat).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.plan import EdgePlan
+
+
+class MessagePassing(nn.Module):
+    """halo-exchange -> [local ; halo] -> ``layer_fn(full, plan)``.
+
+    ``layer_fn`` is a flax module or callable taking the concatenated
+    feature buffer (indices in the plan's halo-slot numbering are valid row
+    ids into it) and the per-shard plan.
+    """
+
+    layer: Any
+    comm: Any
+
+    @nn.compact
+    def __call__(self, x: jax.Array, plan: EdgePlan) -> jax.Array:
+        halo = self.comm.halo_exchange(x, plan.halo)
+        full = jnp.concatenate([x, halo], axis=0)
+        return self.layer(full, plan)

@@ -1,0 +1,54 @@
+"""Parallelism strategies — one namespace over the mesh/collective layer.
+
+Maps the reference's parallelism inventory (SURVEY.md §2.3) onto mesh axes:
+
+- Graph/spatial partition parallelism (the reference's core; activations
+  sharded by vertex, halo exchange per layer — the graph analogue of
+  context/sequence parallelism): the ``graph`` mesh axis +
+  :mod:`dgraph_tpu.comm.collectives`.
+- Data parallelism (DDP gradient all-reduce): the ``replica`` mesh axis +
+  :meth:`~dgraph_tpu.comm.communicator._BaseComm.grad_sync`.
+- Hybrid partition-groups x replicas (``ranks_per_graph``,
+  ``NCCLBackendEngine.py:56-64``): the 2-D ``('replica','graph')`` mesh from
+  :func:`~dgraph_tpu.comm.mesh.make_graph_mesh`.
+- Activation-stat parallelism (distributed BatchNorm,
+  ``distributed_layers.py:22-207``):
+  :class:`~dgraph_tpu.models.norm.DistributedBatchNorm`.
+
+Tensor/pipeline/expert parallelism are absent in the reference (SURVEY §2.3)
+and in scope for later rounds here.
+"""
+
+from dgraph_tpu.comm import collectives
+from dgraph_tpu.comm.collectives import (
+    gather,
+    gather_concat,
+    halo_exchange,
+    halo_scatter_sum,
+    psum_mean,
+    scatter_sum,
+)
+from dgraph_tpu.comm.mesh import (
+    GRAPH_AXIS,
+    REPLICA_AXIS,
+    make_graph_mesh,
+    plan_in_specs,
+    replicated_specs,
+    squeeze_plan,
+)
+
+__all__ = [
+    "collectives",
+    "gather",
+    "gather_concat",
+    "halo_exchange",
+    "halo_scatter_sum",
+    "psum_mean",
+    "scatter_sum",
+    "GRAPH_AXIS",
+    "REPLICA_AXIS",
+    "make_graph_mesh",
+    "plan_in_specs",
+    "replicated_specs",
+    "squeeze_plan",
+]
