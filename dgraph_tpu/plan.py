@@ -333,6 +333,64 @@ class EdgePlan:
     scatter_mc: int = 1
 
 
+def plan_memory_usage(plan: EdgePlan, feature_dim: int, dtype_bytes: int = 4) -> dict:
+    """Byte accounting of a plan and its runtime buffers — parity with
+    ``NCCLGraphCommPlan.memory_usage`` (``_NCCLCommPlan.py:68-100``), printed
+    by the reference before training (``Trainer.py:113-123``).
+
+    Returns per-shard byte counts (every shard is identical in the padded
+    design, unlike the reference's per-rank variable sizes).
+    """
+    W, S = plan.world_size, plan.halo.s_pad
+    idx_bytes = plan.e_pad * 4 * 2 + plan.e_pad * 4  # src/dst idx + mask
+    send_bytes = W * S * (4 + 4)  # send_idx + send_mask
+    halo_buffer = W * S * feature_dim * dtype_bytes
+    send_buffer = W * S * feature_dim * dtype_bytes
+    edge_buffer = plan.e_pad * feature_dim * dtype_bytes
+    return {
+        "plan_index_bytes": idx_bytes + send_bytes,
+        "halo_buffer_bytes": halo_buffer,
+        "send_buffer_bytes": send_buffer,
+        "edge_buffer_bytes": edge_buffer,
+        "total_runtime_bytes": halo_buffer + send_buffer + edge_buffer,
+    }
+
+
+def validate_plan(plan: EdgePlan) -> None:
+    """Host-side structural validation (the index-bounds asserts the
+    reference scatters through its kernels, ``RankLocalOps.py:183-184``;
+    here checked once at build/load time since plans are static).
+    Raises ValueError on any violation."""
+    import numpy as np_
+
+    W, S = plan.world_size, plan.halo.s_pad
+    src_hi = plan.n_src_pad + (W * S if plan.halo_side == "src" else 0)
+    dst_hi = plan.n_dst_pad + (W * S if plan.halo_side == "dst" else 0)
+    src = np_.asarray(plan.src_index)
+    dst = np_.asarray(plan.dst_index)
+    mask = np_.asarray(plan.edge_mask) > 0
+    errors = []
+    if src[mask].size and (src[mask].min() < 0 or src[mask].max() >= src_hi):
+        errors.append(f"src_index out of [0,{src_hi})")
+    if dst[mask].size and (dst[mask].min() < 0 or dst[mask].max() >= dst_hi):
+        errors.append(f"dst_index out of [0,{dst_hi})")
+    send_idx = np_.asarray(plan.halo.send_idx)
+    send_mask = np_.asarray(plan.halo.send_mask) > 0
+    n_halo_owner = plan.n_src_pad if plan.halo_side == "src" else plan.n_dst_pad
+    if send_idx[send_mask].size and (
+        send_idx[send_mask].min() < 0 or send_idx[send_mask].max() >= n_halo_owner
+    ):
+        errors.append(f"halo send_idx out of [0,{n_halo_owner})")
+    for r in range(W):
+        if send_mask[r, r].any():
+            errors.append(f"rank {r} sends to itself")
+    counts = np_.asarray(plan.num_edges)
+    if (counts > plan.e_pad).any():
+        errors.append("num_edges exceeds e_pad")
+    if errors:
+        raise ValueError("invalid EdgePlan: " + "; ".join(errors))
+
+
 @dataclasses.dataclass
 class EdgePlanLayout:
     """Host-side companion of :class:`EdgePlan` (not a pytree; build metadata).

@@ -1,0 +1,60 @@
+"""plan_memory_usage + validate_plan (+ a papers100M-direction scale check)."""
+
+import numpy as np
+import pytest
+
+from dgraph_tpu import plan as pl
+from dgraph_tpu.plan import plan_memory_usage, validate_plan
+
+
+def test_valid_plan_passes(rng):
+    edges = rng.integers(0, 64, size=(2, 400))
+    part = np.sort(rng.integers(0, 8, 64)).astype(np.int32)
+    plan, _ = pl.build_edge_plan(edges, part, world_size=8)
+    validate_plan(plan)  # no raise
+    mem = plan_memory_usage(plan, feature_dim=128)
+    assert mem["total_runtime_bytes"] > 0
+    assert mem["halo_buffer_bytes"] == 8 * plan.halo.s_pad * 128 * 4
+
+
+def test_corrupted_plan_caught(rng):
+    import dataclasses
+
+    edges = rng.integers(0, 64, size=(2, 400))
+    part = np.sort(rng.integers(0, 8, 64)).astype(np.int32)
+    plan, _ = pl.build_edge_plan(edges, part, world_size=8)
+    bad_src = np.asarray(plan.src_index).copy()
+    bad_src[0, 0] = 10_000_000
+    bad = dataclasses.replace(plan, src_index=bad_src)
+    with pytest.raises(ValueError, match="src_index"):
+        validate_plan(bad)
+
+    bad_send = np.asarray(plan.halo.send_mask).copy()
+    bad_send[2, 2, 0] = 1.0  # self-send
+    bad2 = dataclasses.replace(plan, halo=dataclasses.replace(plan.halo, send_mask=bad_send))
+    with pytest.raises(ValueError, match="sends to itself"):
+        validate_plan(bad2)
+
+
+@pytest.mark.slow
+def test_scale_plan_build_5m_edges(rng):
+    """papers100M-direction scale check: 500k vertices / 5M edges through
+    partition + plan build + validation within test-tolerable time. (The
+    real papers100M build, 111M/1.6B, is a batch job: same code path,
+    native dedup, plan cache — SURVEY §7 hard-parts.)"""
+    import time
+
+    from dgraph_tpu import partition as pt
+    from dgraph_tpu.data.synthetic import power_law_graph
+
+    V, W = 500_000, 16
+    edges = power_law_graph(V, 10.0, seed=1)
+    t0 = time.time()
+    part = pt.greedy_bfs_partition(edges, V, W)
+    ren = pt.renumber_contiguous(part, W)
+    new_edges = ren.perm[edges]
+    plan, layout = pl.build_edge_plan(new_edges, ren.partition, world_size=W)
+    dt = time.time() - t0
+    validate_plan(plan)
+    assert float(np.asarray(plan.edge_mask).sum()) == edges.shape[1]
+    assert dt < 120, f"plan build too slow: {dt:.1f}s"
