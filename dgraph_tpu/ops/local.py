@@ -33,6 +33,16 @@ def masked_gather(src: jax.Array, idx: jax.Array, mask: jax.Array) -> jax.Array:
     return src[idx] * mask[..., None]
 
 
+def masked_scatter(
+    dst: jax.Array, idx: jax.Array, src: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """dst[idx[i]] = src[i] where mask[i] — ``Masked_Scatter_Gather_Kernel``
+    with the Set op (``local_data_kernels.cuh:301-342``); set semantics, last
+    writer wins on duplicates (XLA scatter)."""
+    safe_idx = jnp.where(mask > 0, idx, dst.shape[0])  # OOB rows dropped
+    return dst.at[safe_idx].set(src, mode="drop")
+
+
 def segment_sum(
     data: jax.Array,
     segment_ids: jax.Array,
