@@ -37,30 +37,75 @@ from dgraph_tpu.plan import EdgePlan, HaloSpec
 from dgraph_tpu.ops import local as local_ops
 
 
+def _use_ppermute(axis_name, deltas) -> bool:
+    from dgraph_tpu import config as _cfg
+
+    if axis_name is None or deltas is None:
+        return False
+    impl = _cfg.halo_impl
+    if impl == "ppermute":
+        return True
+    if impl == "all_to_all":
+        return False
+    # auto: neighbor rounds win when the peer set is sparse (locality
+    # partitions on mesh-like graphs); all_to_all wins all-pairs traffic
+    W = jax.lax.psum(1, axis_name)
+    return 0 < len(deltas) <= max(1, W // 2)
+
+
 def halo_exchange(
-    x: jax.Array, halo: HaloSpec, axis_name: Optional[str]
+    x: jax.Array,
+    halo: HaloSpec,
+    axis_name: Optional[str],
+    deltas: Optional[tuple] = None,
 ) -> jax.Array:
     """Exchange boundary vertex features; returns the halo buffer.
+
+    Two lowerings, same result layout:
+    - all_to_all (default): one padded collective; received block from peer
+      p lands at rows ``[p*S, (p+1)*S)`` — exactly the plan's halo-slot
+      numbering, no receive-placement pass.
+    - ppermute neighbor rounds (when ``deltas`` — the static set of rank
+      offsets with traffic — is sparse): one CollectivePermute per delta,
+      skipping empty peer pairs entirely (SURVEY §7 "ppermute rounds only
+      to actual neighbors"; the NVSHMEM one-sided put analogue).
 
     Args:
       x: [n_pad, F] local (padded) vertex features of this shard.
       halo: per-shard spec; send_idx [W, S], send_mask [W, S].
       axis_name: mesh axis to exchange over, or None (single device).
-
-    Returns: [W*S, F] halo features; the block from peer p occupies rows
-    ``[p*S, (p+1)*S)`` — i.e. exactly the halo-slot numbering the plan
-    builder used for edge indices.
+      deltas: static tuple of active (peer-rank) mod W offsets
+        (``EdgePlan.halo_deltas``); None disables the ppermute path.
     """
-    send = x[halo.send_idx] * halo.send_mask[..., None]  # [W, S, F]
+    F = x.shape[-1]
+    W, S = halo.send_idx.shape[0], halo.s_pad
     if axis_name is None:
-        recv = send  # world_size 1: no cross edges; mask is all-zero
-    else:
-        recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0)
-    return recv.reshape(-1, x.shape[-1])
+        send = x[halo.send_idx] * halo.send_mask[..., None]
+        return send.reshape(-1, F)  # world size 1: mask is all-zero
+    if _use_ppermute(axis_name, deltas):
+        me = lax.axis_index(axis_name)
+        out = jnp.zeros((W * S, F), x.dtype)
+        for d in deltas:
+            peer_row = (me + d) % W
+            idx = jnp.take(halo.send_idx, peer_row, axis=0)
+            msk = jnp.take(halo.send_mask, peer_row, axis=0)
+            send = x[idx] * msk[..., None]  # [S, F]
+            perm = [(i, (i + d) % W) for i in range(W)]
+            recv = lax.ppermute(send, axis_name, perm)
+            src_rank = (me - d) % W
+            out = lax.dynamic_update_slice(out, recv, (src_rank * S, 0))
+        return out
+    send = x[halo.send_idx] * halo.send_mask[..., None]  # [W, S, F]
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0)
+    return recv.reshape(-1, F)
 
 
 def halo_scatter_sum(
-    h: jax.Array, halo: HaloSpec, n_pad: int, axis_name: Optional[str]
+    h: jax.Array,
+    halo: HaloSpec,
+    n_pad: int,
+    axis_name: Optional[str],
+    deltas: Optional[tuple] = None,
 ) -> jax.Array:
     """Linear transpose of :func:`halo_exchange`: deliver halo-slot values
     back to their owner ranks and sum into local vertices.
@@ -73,8 +118,25 @@ def halo_scatter_sum(
       h: [W*S, F] halo-buffer values on this shard.
     Returns: [n_pad, F] per-local-vertex sums.
     """
-    W = halo.send_idx.shape[0]
-    h = h.reshape(W, halo.s_pad, -1)
+    W, S = halo.send_idx.shape[0], halo.s_pad
+    F = h.shape[-1]
+    if axis_name is not None and _use_ppermute(axis_name, deltas):
+        me = lax.axis_index(axis_name)
+        out = jnp.zeros((n_pad, F), h.dtype)
+        h2 = h.reshape(W, S, F)
+        for d in deltas:
+            # my halo rows from rank (me-d) go back to their owner (me-d);
+            # I receive my own vertices' partials from rank (me+d)
+            src_rank = (me - d) % W
+            block = lax.dynamic_slice(h.reshape(W * S, F), (src_rank * S, 0), (S, F))
+            perm = [(i, (i - d) % W) for i in range(W)]
+            recv = lax.ppermute(block, axis_name, perm)  # from rank (me+d)
+            peer_row = (me + d) % W
+            idx = jnp.take(halo.send_idx, peer_row, axis=0)
+            msk = jnp.take(halo.send_mask, peer_row, axis=0)
+            out = out + local_ops.segment_sum(recv * msk[..., None], idx, n_pad)
+        return out
+    h = h.reshape(W, S, F)
     if axis_name is None:
         back = h
     else:
@@ -108,7 +170,7 @@ def gather(
     """
     idx = _side_index(plan, side)
     if side == plan.halo_side:
-        haloed = halo_exchange(x, plan.halo, axis_name)
+        haloed = halo_exchange(x, plan.halo, axis_name, deltas=plan.halo_deltas)
         full = jnp.concatenate([x, haloed], axis=0)
     else:
         full = x
@@ -155,7 +217,9 @@ def scatter_sum(
     full = local_ops.segment_sum(edata, idx, n_pad + W * plan.halo.s_pad)
     local_part = full[:n_pad]
     remote_part = full[n_pad:]
-    return local_part + halo_scatter_sum(remote_part, plan.halo, n_pad, axis_name)
+    return local_part + halo_scatter_sum(
+        remote_part, plan.halo, n_pad, axis_name, deltas=plan.halo_deltas
+    )
 
 
 def gather_concat(

@@ -25,6 +25,11 @@ use_pallas_scatter: bool = _env_flag("DGRAPH_TPU_PALLAS_SCATTER", False)
 # float32). Models read this at construction time.
 default_compute_dtype: str = os.environ.get("DGRAPH_TPU_COMPUTE_DTYPE", "float32")
 
+# Halo exchange lowering: 'auto' (ppermute neighbor rounds when the plan's
+# active peer-delta set is sparse, else one padded all_to_all),
+# 'all_to_all', or 'ppermute'.
+halo_impl: str = os.environ.get("DGRAPH_TPU_HALO_IMPL", "auto")
+
 
 def set_flags(**kw) -> None:
     g = globals()

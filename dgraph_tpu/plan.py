@@ -287,6 +287,7 @@ class HaloSpec:
         "homogeneous",
         "owner_sorted",
         "scatter_mc",
+        "halo_deltas",
     )
 )
 class EdgePlan:
@@ -331,6 +332,12 @@ class EdgePlan:
     # Pallas scheduling hint: max edge-chunks any (block_n=256) vertex block
     # spans at block_e=256, maxed over shards (see ops.pallas_segment)
     scatter_mc: int = 1
+    # Static tuple of rank-deltas ((peer - rank) mod W) with nonzero halo
+    # traffic anywhere in the mesh. When sparse (locality partitions), the
+    # halo exchange can run as len(halo_deltas) ppermute rounds instead of a
+    # padded all_to_all — SURVEY §7 "ppermute rounds only to actual
+    # neighbors". () means no cross-rank traffic.
+    halo_deltas: tuple = ()
 
 
 def plan_memory_usage(plan: EdgePlan, feature_dim: int, dtype_bytes: int = 4) -> dict:
@@ -609,6 +616,11 @@ def build_edge_plan(
         homogeneous=homogeneous,
         owner_sorted=sort_edges,
         scatter_mc=scatter_mc,
+        halo_deltas=tuple(
+            int(d)
+            for d in np.unique((needer - sender) % W)
+            if halo_counts.sum() > 0
+        ),
     )
     layout = EdgePlanLayout(
         edge_rank=edge_rank,
