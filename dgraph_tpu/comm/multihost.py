@@ -1,0 +1,80 @@
+"""Multi-host (multi-controller) launch + DCN/ICI mesh construction.
+
+Replaces the reference's launcher matrix — torchrun for NCCL, mpirun/srun
+for MPI/NVSHMEM with THREAD_MULTIPLE requirements and per-backend rank
+bookkeeping (``MPIBackendEngine.py:268-341``, SURVEY §3.1) — with the JAX
+multi-controller model: every host runs the same program,
+``jax.distributed.initialize`` wires the cluster, and a single global mesh
+spans all devices.
+
+Axis placement for pods/multi-slice: the ``graph`` axis (per-layer halo
+all_to_all — latency-critical) goes on the INNER, ICI-contiguous dimension;
+``replica`` (one grad all-reduce per step — bandwidth-tolerant) on the
+OUTER dimension, which XLA routes over DCN for multi-slice topologies.
+``jax.experimental.mesh_utils.create_hybrid_device_mesh`` handles the
+slice-aware ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from dgraph_tpu.comm.mesh import GRAPH_AXIS, REPLICA_AXIS
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """``jax.distributed.initialize`` passthrough (auto-detects on TPU pods;
+    explicit args for manual launches). Idempotent."""
+    try:
+        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    except RuntimeError as e:  # already initialized
+        if "already" not in str(e).lower():
+            raise
+
+
+def make_pod_mesh(ranks_per_graph: Optional[int] = None, num_replicas: int = 1):
+    """Global mesh over ALL processes' devices, DCN-aware when multi-slice.
+
+    Single-slice (or CPU): plain ``make_graph_mesh``. Multi-slice: replicas
+    map to slices (DCN) and graph shards stay within a slice (ICI).
+    """
+    devices = jax.devices()
+    n = len(devices)
+    if ranks_per_graph is None:
+        ranks_per_graph = n // num_replicas
+    if ranks_per_graph * num_replicas != n:
+        raise ValueError(
+            f"ranks_per_graph ({ranks_per_graph}) x num_replicas ({num_replicas}) != {n}"
+        )
+    num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if num_slices > 1 and num_replicas % num_slices == 0:
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh
+
+        dm = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(num_replicas // num_slices, ranks_per_graph),
+            dcn_mesh_shape=(num_slices, 1),
+            devices=devices,
+        )
+        return Mesh(dm, (REPLICA_AXIS, GRAPH_AXIS))
+    from dgraph_tpu.comm.mesh import make_graph_mesh
+
+    return make_graph_mesh(ranks_per_graph, num_replicas, devices)
+
+
+def process_local_shards(world_size: int) -> list:
+    """Which graph shards this process should materialize host-side — for
+    per-host data loading of very large graphs (each controller feeds only
+    its addressable devices, the reference's per-rank dataset slicing,
+    ``data/ogbn_datasets.py:135-148``)."""
+    local = jax.local_devices()
+    all_dev = jax.devices()
+    index_of = {d.id: i for i, d in enumerate(all_dev)}
+    n = len(all_dev)
+    return sorted({index_of[d.id] * world_size // n for d in local})

@@ -1,0 +1,76 @@
+"""Plot benchmark/training artifacts.
+
+Reference parity: ``experiments/Benchmarks/generate_plots.py`` (mean+-std
+latency bars from .npy dumps) and ``experiments/OGB/plot_timing_reports.py``
+(stacked phase bars) / ``utils.py:33-49`` (mean+-std training trajectories).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+
+
+@dataclasses.dataclass
+class Config:
+    """Render plots from logs/ artifacts."""
+
+    log_dir: str = "logs"
+    out_dir: str = "logs/plots"
+
+
+def main(cfg: Config):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    os.makedirs(cfg.out_dir, exist_ok=True)
+
+    # --- comm benchmark latency bars ---
+    npys = sorted(glob.glob(os.path.join(cfg.log_dir, "comm_bench_*_times.npy")))
+    if npys:
+        names, means, stds = [], [], []
+        for p in npys:
+            t = np.load(p)
+            names.append(os.path.basename(p).replace("comm_bench_", "").replace("_times.npy", ""))
+            means.append(t.mean())
+            stds.append(t.std())
+        fig, ax = plt.subplots(figsize=(6, 4))
+        ax.bar(names, means, yerr=stds, capsize=4)
+        ax.set_ylabel("latency (ms)")
+        ax.set_title("distributed gather/scatter latency (mean ± std)")
+        fig.tight_layout()
+        fig.savefig(os.path.join(cfg.out_dir, "comm_latency.png"), dpi=120)
+        print(f"wrote {cfg.out_dir}/comm_latency.png")
+
+    # --- training trajectories from JSONL logs ---
+    for log in sorted(glob.glob(os.path.join(cfg.log_dir, "*.jsonl"))):
+        rows = []
+        for line in open(log):
+            if line.startswith("{"):
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        xs = [r.get("epoch", r.get("step")) for r in rows if "loss" in r]
+        ys = [r["loss"] for r in rows if "loss" in r]
+        if len(ys) >= 2 and all(x is not None for x in xs):
+            fig, ax = plt.subplots(figsize=(6, 4))
+            ax.plot(xs, ys)
+            ax.set_xlabel("epoch/step")
+            ax.set_ylabel("loss")
+            ax.set_title(os.path.basename(log))
+            fig.tight_layout()
+            name = os.path.basename(log).replace(".jsonl", "") + "_loss.png"
+            fig.savefig(os.path.join(cfg.out_dir, name), dpi=120)
+            print(f"wrote {cfg.out_dir}/{name}")
+
+
+if __name__ == "__main__":
+    from dgraph_tpu.utils.cli import parse_config
+
+    main(parse_config(Config))

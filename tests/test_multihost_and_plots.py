@@ -1,0 +1,29 @@
+"""Multi-host mesh helpers (single-process degenerate cases) + plot script."""
+
+import json
+import os
+
+import numpy as np
+
+
+def test_make_pod_mesh_single_slice(mesh8):
+    from dgraph_tpu.comm.multihost import make_pod_mesh, process_local_shards
+
+    mesh = make_pod_mesh(ranks_per_graph=4, num_replicas=2)
+    assert dict(mesh.shape) == {"replica": 2, "graph": 4}
+    shards = process_local_shards(8)
+    assert shards == list(range(8))  # single controller owns every shard
+
+
+def test_generate_plots(tmp_path):
+    from experiments.generate_plots import Config, main
+
+    log_dir = tmp_path / "logs"
+    os.makedirs(log_dir)
+    np.save(log_dir / "comm_bench_gather_times.npy", np.array([1.0, 2.0, 3.0]))
+    with open(log_dir / "train.jsonl", "w") as f:
+        for i in range(5):
+            f.write(json.dumps({"epoch": i, "loss": 1.0 / (i + 1)}) + "\n")
+    main(Config(log_dir=str(log_dir), out_dir=str(tmp_path / "plots")))
+    assert (tmp_path / "plots" / "comm_latency.png").exists()
+    assert (tmp_path / "plots" / "train_loss.png").exists()
