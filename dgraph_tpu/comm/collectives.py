@@ -37,6 +37,23 @@ from dgraph_tpu.plan import EdgePlan, HaloSpec
 from dgraph_tpu.ops import local as local_ops
 
 
+def _scoped(name: str):
+    """Profiler annotation (the nvtx.annotate analogue,
+    ``microbenchmark_graphcast.py:126``): every collective shows up as a
+    named region in jax.profiler/Perfetto traces."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with jax.named_scope(name):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
+
+
 def _use_ppermute(axis_name, deltas) -> bool:
     from dgraph_tpu import config as _cfg
 
@@ -53,6 +70,7 @@ def _use_ppermute(axis_name, deltas) -> bool:
     return 0 < len(deltas) <= max(1, W // 2)
 
 
+@_scoped("dgraph.halo_exchange")
 def halo_exchange(
     x: jax.Array,
     halo: HaloSpec,
@@ -100,6 +118,7 @@ def halo_exchange(
     return recv.reshape(-1, F)
 
 
+@_scoped("dgraph.halo_scatter_sum")
 def halo_scatter_sum(
     h: jax.Array,
     halo: HaloSpec,
@@ -123,7 +142,6 @@ def halo_scatter_sum(
     if axis_name is not None and _use_ppermute(axis_name, deltas):
         me = lax.axis_index(axis_name)
         out = jnp.zeros((n_pad, F), h.dtype)
-        h2 = h.reshape(W, S, F)
         for d in deltas:
             # my halo rows from rank (me-d) go back to their owner (me-d);
             # I receive my own vertices' partials from rank (me+d)
@@ -154,6 +172,7 @@ def _side_npad(plan: EdgePlan, side: str) -> int:
     return plan.n_src_pad if side == "src" else plan.n_dst_pad
 
 
+@_scoped("dgraph.gather")
 def gather(
     x: jax.Array, plan: EdgePlan, side: str, axis_name: Optional[str]
 ) -> jax.Array:
@@ -177,6 +196,7 @@ def gather(
     return full[idx] * plan.edge_mask[:, None]
 
 
+@_scoped("dgraph.scatter_sum")
 def scatter_sum(
     edata: jax.Array, plan: EdgePlan, side: str, axis_name: Optional[str]
 ) -> jax.Array:
@@ -222,6 +242,7 @@ def scatter_sum(
     )
 
 
+@_scoped("dgraph.gather_concat")
 def gather_concat(
     x_src: jax.Array,
     x_dst: jax.Array,
