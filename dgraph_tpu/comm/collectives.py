@@ -37,21 +37,9 @@ from dgraph_tpu.plan import EdgePlan, HaloSpec
 from dgraph_tpu.ops import local as local_ops
 
 
-def _scoped(name: str):
-    """Profiler annotation (the nvtx.annotate analogue,
-    ``microbenchmark_graphcast.py:126``): every collective shows up as a
-    named region in jax.profiler/Perfetto traces."""
-    import functools
-
-    def deco(fn):
-        @functools.wraps(fn)
-        def wrapper(*a, **kw):
-            with jax.named_scope(name):
-                return fn(*a, **kw)
-
-        return wrapper
-
-    return deco
+# Every collective shows up as a named region in jax.profiler/Perfetto
+# traces (canonical alias lives in utils.timing).
+from dgraph_tpu.utils.timing import named_scope as _scoped  # noqa: E402
 
 
 def _use_ppermute(axis_name, deltas) -> bool:

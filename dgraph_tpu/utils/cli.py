@@ -12,11 +12,36 @@ import dataclasses
 import typing
 
 
+def _sync_platform_from_env() -> None:
+    """Make ``JAX_PLATFORMS`` in the environment authoritative for CLI runs.
+
+    Ambient sitecustomize hooks (e.g. the axon TPU tunnel's) may pin
+    ``jax_platforms`` via ``jax.config`` at interpreter startup, which
+    silently overrides the user's ``JAX_PLATFORMS=cpu`` — so
+    ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+    python experiments/...`` would land on 1 real chip instead of the 8
+    virtual devices asked for. Re-assert the env var before any backend
+    initialization (no-op when they already agree or jax is absent)."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+
+        if jax.config.jax_platforms != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:
+        pass
+
+
 def parse_config(config_cls, argv=None):
     """Build ``config_cls()`` then apply ``--field value`` / ``--a.b value``
     overrides, coercing to the annotated field type."""
     import sys
 
+    _sync_platform_from_env()
     argv = list(sys.argv[1:] if argv is None else argv)
     if "--help" in argv or "-h" in argv:
         print(config_cls.__doc__ or config_cls.__name__)

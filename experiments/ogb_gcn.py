@@ -146,6 +146,11 @@ def main(cfg: Config):
 
 
 if __name__ == "__main__":
+    import os as _os, sys as _sys
+
+    # direct-invocation support (repo not pip-installed): put the repo
+    # root on sys.path so `python experiments/<script>.py` works
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
     from dgraph_tpu.utils.cli import parse_config
 
     main(parse_config(Config))

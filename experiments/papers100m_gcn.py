@@ -4,17 +4,23 @@ target; BASELINE.md north star: papers100M epoch time on a v5p-32).
 111M vertices / 1.6B edges don't fit one chip; the recipe here is the
 framework's memory-scaling stack (SURVEY §7 step 9):
 - vertices int32-renumbered, sharded over the full `graph` axis
-- per-host data loading of only the local shards
-  (``comm.multihost.process_local_shards``)
 - hash-keyed on-disk plan cache so the multi-hour plan build happens once
   (``train/checkpoint.cached_edge_plan``; reference pattern
   ``MAG240M_dataset.py:237-260``)
 - remat (``jax.checkpoint``) on the conv layers to trade FLOPs for HBM
 - bfloat16 compute
 
-Data: ``--data_npz`` pointing at edge_index/features/labels/masks arrays
-(memmap-compatible .npz or .npy directory), or ``--synthetic_scale`` for a
+Data: ``--data_npz`` pointing at either a ``.npz`` archive (loaded eagerly)
+or a DIRECTORY of ``edge_index.npy`` / ``features.npy`` / ``labels.npy`` /
+``train_mask.npy`` files — the directory form is opened with
+``np.load(..., mmap_mode="r")`` so the 57 GB papers100M feature matrix is
+never fully resident on the host. ``--synthetic_scale`` gives a
 shape-matched power-law synthetic at a chosen fraction of papers100M.
+
+This script is single-controller; each run partitions and shards the full
+graph host-side. For multi-controller pods,
+``comm.multihost.process_local_shards`` picks which shards each host
+should materialize.
 """
 
 from __future__ import annotations
@@ -61,10 +67,24 @@ def main(cfg: Config):
     log = ExperimentLog(cfg.log_path)
 
     if cfg.data_npz:
-        z = np.load(cfg.data_npz, mmap_mode="r")
+        import os
+
+        if os.path.isdir(cfg.data_npz):
+            # directory of .npy files: true memmaps, nothing loaded eagerly
+            z = {
+                k: np.load(os.path.join(cfg.data_npz, k + ".npy"), mmap_mode="r")
+                for k in ("edge_index", "features", "labels", "train_mask")
+            }
+        else:
+            z = np.load(cfg.data_npz)  # .npz archive (eager)
         edge_index, feats = z["edge_index"], z["features"]
-        labels = z["labels"]
+        labels = np.asarray(z["labels"]).squeeze()
         train_mask = z["train_mask"]
+        # OGB papers100M labels are float with NaN on the ~98% unlabeled
+        # nodes; map NaN -> class 0 (loss-masked by train_mask anyway).
+        if np.issubdtype(labels.dtype, np.floating):
+            labels = np.where(np.isnan(labels), 0, labels)
+        labels = labels.astype(np.int64)
         C = int(labels.max()) + 1
     else:
         from dgraph_tpu.data.synthetic import power_law_graph
@@ -80,9 +100,7 @@ def main(cfg: Config):
 
     V = feats.shape[0]
     TimingReport.start("partition")
-    part = pt.greedy_bfs_partition(edge_index, V, world)
-    ren = pt.renumber_contiguous(part, world)
-    new_edges = ren.perm[np.asarray(edge_index)]
+    new_edges, ren = pt.partition_graph(edge_index, V, world, method="greedy_bfs")
     TimingReport.stop("partition")
 
     TimingReport.start("plan_build")
@@ -99,13 +117,13 @@ def main(cfg: Config):
     TimingReport.stop("shard_data")
 
     dtype = jnp.bfloat16 if cfg.bfloat16 else None
-    model = GCN(cfg.hidden, C, comm=comm, num_layers=cfg.num_layers, dtype=dtype)
     if cfg.remat:
         import flax.linen as nn
 
-        model = nn.remat(GCN)(
-            cfg.hidden, C, comm=comm, num_layers=cfg.num_layers, dtype=dtype
-        )
+        cls = nn.remat(GCN)
+    else:
+        cls = GCN
+    model = cls(cfg.hidden, C, comm=comm, num_layers=cfg.num_layers, dtype=dtype)
 
     plan = jax.tree.map(jnp.asarray, plan_np)
     batch = {"x": jnp.asarray(x), "y": jnp.asarray(y), "mask": jnp.asarray(m)}
@@ -134,6 +152,11 @@ def main(cfg: Config):
 
 
 if __name__ == "__main__":
+    import os as _os, sys as _sys
+
+    # direct-invocation support (repo not pip-installed): put the repo
+    # root on sys.path so `python experiments/<script>.py` works
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
     from dgraph_tpu.utils.cli import parse_config
 
     main(parse_config(Config))
