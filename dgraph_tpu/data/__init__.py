@@ -1,4 +1,4 @@
 from dgraph_tpu.data.graph import DistributedGraph
-from dgraph_tpu.data import synthetic
+from dgraph_tpu.data import memmap, synthetic
 
-__all__ = ["DistributedGraph", "synthetic"]
+__all__ = ["DistributedGraph", "memmap", "synthetic"]

@@ -1,0 +1,167 @@
+"""On-disk memmap datasets for graphs whose features exceed host RAM.
+
+Reference parity: the MAG240M pipeline (``experiments/OGB-LSC/lsc_datasets/
+MAG240M_dataset.py:116-320``) generates a node-feature memmap from ogb.lsc
+once, then every rank opens it read-only and slices out only its own rows.
+The TPU-native version keeps the same shape:
+
+- :func:`open_memmap_dataset` / :func:`create_memmap_dataset`: a directory of
+  ``.npy`` files opened with ``np.load(mmap_mode="r")`` — nothing resident
+  until rows are touched.
+- :func:`shard_rows`: materialize ONLY the requested ranks' row blocks
+  (fancy-indexing a memmap reads just those pages). Combined with
+  ``comm.multihost.process_local_shards`` this is the per-host loading story
+  for multi-controller pods (reference per-rank slicing,
+  ``data/ogbn_datasets.py:135-148``).
+- :func:`generate_chunked`: stream-write a dataset in row chunks so the
+  111M x 128 papers100M feature matrix is never in RAM during generation
+  (reference memmap-generation loop, ``MAG240M_dataset.py:150-220``).
+
+Everything here is host-side numpy — no jax; device placement happens in the
+training scripts after sharding.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+_META = "dgraph_meta.json"
+
+
+def create_memmap_dataset(
+    path: str, schema: dict[str, tuple[tuple[int, ...], str]]
+) -> dict[str, np.memmap]:
+    """Create a directory of writable ``.npy`` memmaps.
+
+    Args:
+      schema: name -> (shape, dtype-string), e.g.
+        ``{"features": ((V, 128), "float32"), "labels": ((V,), "int32")}``.
+    Returns name -> writable memmap (flush with ``.flush()`` or just let the
+    process exit; the data lives in the page cache/disk).
+    """
+    os.makedirs(path, exist_ok=True)
+    arrays = {}
+    for name, (shape, dtype) in schema.items():
+        arrays[name] = np.lib.format.open_memmap(
+            os.path.join(path, name + ".npy"), mode="w+", dtype=np.dtype(dtype), shape=tuple(shape)
+        )
+    with open(os.path.join(path, _META), "w") as f:
+        json.dump(
+            {n: {"shape": list(s), "dtype": d} for n, (s, d) in schema.items()}, f
+        )
+    return arrays
+
+
+def open_memmap_dataset(path: str, names: Optional[Iterable[str]] = None) -> dict:
+    """Open a directory of ``.npy`` files read-only as memmaps."""
+    if names is None:
+        names = [
+            f[: -len(".npy")] for f in sorted(os.listdir(path)) if f.endswith(".npy")
+        ]
+    return {
+        n: np.load(os.path.join(path, n + ".npy"), mmap_mode="r") for n in names
+    }
+
+
+def generate_chunked(
+    out: np.memmap,
+    make_chunk: Callable[[int, int], np.ndarray],
+    chunk_rows: int = 1 << 20,
+) -> np.memmap:
+    """Fill ``out`` row-block by row-block: ``out[lo:hi] = make_chunk(lo, hi)``.
+
+    Keeps peak RAM at one chunk regardless of total size — the reference's
+    memmap feature-generation loop shape (``MAG240M_dataset.py:150-220``).
+    """
+    n = out.shape[0]
+    for lo in range(0, n, chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        out[lo:hi] = make_chunk(lo, hi)
+    out.flush()
+    return out
+
+
+def shard_rows(
+    data,
+    inv: np.ndarray,
+    offsets: np.ndarray,
+    n_pad: int,
+    shard_ids: Iterable[int],
+    dtype=None,
+) -> np.ndarray:
+    """Materialize selected ranks' padded row blocks from a (memmap) array.
+
+    Args:
+      data: [V, ...] array or memmap in ORIGINAL vertex numbering.
+      inv: renumbering's inverse permutation (new id -> original id,
+        ``partition.Renumbering.inv``) — rank r owns new ids
+        ``offsets[r]:offsets[r+1]``.
+      offsets: [W+1] rank block offsets in the new numbering.
+      n_pad: padded per-shard row count.
+      shard_ids: which ranks to materialize (e.g.
+        ``comm.multihost.process_local_shards(W)``); only these rows are
+        ever read from disk.
+    Returns [len(shard_ids), n_pad, ...] with zero padding.
+    """
+    shard_ids = list(shard_ids)
+    tail = data.shape[1:]
+    dtype = np.dtype(dtype) if dtype is not None else data.dtype
+    out = np.zeros((len(shard_ids), n_pad) + tuple(tail), dtype)
+    for i, r in enumerate(shard_ids):
+        rows = inv[offsets[r] : offsets[r + 1]]
+        # memmap fancy-indexing reads only the touched pages; sort the row
+        # ids for sequential disk access then restore plan order
+        order = np.argsort(rows, kind="stable")
+        got = np.asarray(data[rows[order]], dtype)
+        undo = np.empty_like(order)
+        undo[order] = np.arange(len(order))
+        out[i, : len(rows)] = got[undo]
+    return out
+
+
+def synthetic_papers_like(
+    path: str,
+    num_nodes: int,
+    feat_dim: int = 128,
+    num_classes: int = 172,
+    avg_degree: float = 14.5,
+    train_frac: float = 0.01,
+    seed: int = 0,
+    chunk_rows: int = 1 << 20,
+) -> str:
+    """Write a papers100M-shaped dataset to disk without holding it in RAM.
+
+    Edge list from the same power-law generator as
+    ``data.synthetic.power_law_graph``; features streamed chunk-wise.
+    Returns ``path`` (loadable by ``experiments/papers100m_gcn.py
+    --data_npz <path>`` and :func:`open_memmap_dataset`).
+    """
+    from dgraph_tpu.data.synthetic import power_law_graph
+
+    edges = power_law_graph(num_nodes, avg_degree, seed=seed)
+    arrays = create_memmap_dataset(
+        path,
+        {
+            "edge_index": (tuple(edges.shape), "int64"),
+            "features": ((num_nodes, feat_dim), "float32"),
+            "labels": ((num_nodes,), "int32"),
+            "train_mask": ((num_nodes,), "bool"),
+        },
+    )
+    arrays["edge_index"][:] = edges
+
+    def feat_chunk(lo, hi):
+        r = np.random.default_rng(seed + 1 + lo)
+        return r.normal(size=(hi - lo, feat_dim)).astype(np.float32)
+
+    generate_chunked(arrays["features"], feat_chunk, chunk_rows)
+    r = np.random.default_rng(seed + 2)
+    arrays["labels"][:] = r.integers(0, num_classes, num_nodes).astype(np.int32)
+    arrays["train_mask"][:] = r.random(num_nodes) < train_frac
+    for a in arrays.values():
+        a.flush()
+    return path

@@ -63,6 +63,44 @@ def segment_sum(
     )
 
 
+def scatter_add_relu(
+    data: jax.Array, segment_ids: jax.Array, num_segments: int,
+    indices_are_sorted: bool = False,
+) -> jax.Array:
+    """out[s] = Σ max(data[i], 0) over segment s — parity with the reference's
+    fused ReLU+atomicAdd kernel (``Fused_ReLU_Scatter_Kernel``,
+    ``local_data_kernels.cuh:34-72``). On TPU the ReLU fuses into the
+    segment reduction's input by XLA; expressing it as one call keeps the
+    reference's fused API surface."""
+    return segment_sum(
+        jax.nn.relu(data), segment_ids, num_segments, indices_are_sorted
+    )
+
+
+def scatter_add_sum_relu(
+    data1: jax.Array, data2: jax.Array, segment_ids: jax.Array, num_segments: int,
+    indices_are_sorted: bool = False,
+) -> jax.Array:
+    """out[s] = Σ max(data1[i] + data2[i], 0) — parity with
+    ``Fused_Sum_Norm_Scatter_Kernel`` (``local_data_kernels.cuh:74-116``):
+    residual-add + ReLU fused into the scatter. One XLA fusion on TPU."""
+    return segment_sum(
+        jax.nn.relu(data1 + data2), segment_ids, num_segments, indices_are_sorted
+    )
+
+
+def sparse_scatter_add(dst: jax.Array, idx: jax.Array, src: jax.Array) -> jax.Array:
+    """dst[idx[i]] += src[i], rows with idx < 0 (or >= len(dst)) dropped —
+    parity with ``Sparse_Scatter_Kernel`` (``local_data_kernels.cuh:117-158``),
+    the reference's "-1 means skip" masking convention (SURVEY §7).
+
+    Negative indices would WRAP under JAX's .at[] semantics, so they are
+    remapped to an out-of-bounds sentinel that mode="drop" discards.
+    """
+    idx = jnp.where(idx < 0, dst.shape[0], idx)
+    return dst.at[idx].add(src, mode="drop")
+
+
 def segment_max(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
     """Per-segment max (for attention softmax stabilization). Empty segments
     produce -inf; callers mask afterwards."""

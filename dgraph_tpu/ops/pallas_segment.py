@@ -35,7 +35,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _kernel(starts_ref, counts_ref, ids_ref, data_ref, out_ref, *, block_n, block_e):
+def _kernel(
+    starts_ref, counts_ref, ids_ref, data_ref, out_ref, *, block_n, block_e, input_op
+):
     b = pl.program_id(0)
     k = pl.program_id(1)
 
@@ -47,6 +49,11 @@ def _kernel(starts_ref, counts_ref, ids_ref, data_ref, out_ref, *, block_n, bloc
     def _accumulate():
         ids = ids_ref[0]  # [block_e] int32 (global segment ids)
         chunk = data_ref[0]  # [block_e, F]
+        if input_op == "relu":
+            # fused ReLU epilogue on the scatter input — the reference's
+            # Fused_ReLU_Scatter_Kernel (local_data_kernels.cuh:34-72) done
+            # in-VMEM before the one-hot contraction
+            chunk = jnp.maximum(chunk, 0)
         rel = ids - b * block_n
         valid = (rel >= 0) & (rel < block_n)
         rel = jnp.where(valid, rel, 0)
@@ -64,7 +71,10 @@ def _kernel(starts_ref, counts_ref, ids_ref, data_ref, out_ref, *, block_n, bloc
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_segments", "max_chunks_per_block", "block_e", "block_n", "interpret"),
+    static_argnames=(
+        "num_segments", "max_chunks_per_block", "block_e", "block_n", "interpret",
+        "input_op",
+    ),
 )
 def sorted_segment_sum(
     data: jax.Array,  # [E, F]
@@ -75,6 +85,7 @@ def sorted_segment_sum(
     block_e: int = 256,
     block_n: int = 256,
     interpret: bool = False,
+    input_op: str = "none",  # "none" | "relu" (fused input epilogue)
 ) -> jax.Array:
     """Segment sum for sorted ids. Rows with ids outside [0, num_segments)
     are dropped (use an out-of-range id for masked edges).
@@ -83,6 +94,8 @@ def sorted_segment_sum(
     ceil(edges_in_any_block/block_e) + 1 (the +1 covers chunk misalignment);
     compute it at plan-build time with :func:`max_chunks_hint`.
     """
+    if input_op not in ("none", "relu"):
+        raise ValueError(f"input_op must be 'none' or 'relu', got {input_op!r}")
     E, F = data.shape
     E_pad = pl.cdiv(E, block_e) * block_e
     N_pad = pl.cdiv(num_segments, block_n) * block_n
@@ -130,7 +143,7 @@ def sorted_segment_sum(
         out_specs=pl.BlockSpec((block_n, F), lambda b, k, starts, counts: (b, 0)),
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, block_n=block_n, block_e=block_e),
+        functools.partial(_kernel, block_n=block_n, block_e=block_e, input_op=input_op),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((N_pad, F), data.dtype),
         interpret=interpret,

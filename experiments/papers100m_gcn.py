@@ -13,9 +13,14 @@ framework's memory-scaling stack (SURVEY §7 step 9):
 Data: ``--data_npz`` pointing at either a ``.npz`` archive (loaded eagerly)
 or a DIRECTORY of ``edge_index.npy`` / ``features.npy`` / ``labels.npy`` /
 ``train_mask.npy`` files — the directory form is opened with
-``np.load(..., mmap_mode="r")`` so the 57 GB papers100M feature matrix is
-never fully resident on the host. ``--synthetic_scale`` gives a
-shape-matched power-law synthetic at a chosen fraction of papers100M.
+``np.load(..., mmap_mode="r")``, so shard materialization streams rows from
+disk instead of first building a second in-RAM copy of the feature matrix.
+NOTE: this single-controller script still materializes ONE full padded
+[W, n_pad, F] copy host-side before device transfer (~57 GB for real
+papers100M); only the multi-controller path, which passes
+``process_local_shards`` to ``shard_rows``, keeps per-host residency at
+1/num_hosts of that. ``--synthetic_scale`` gives a shape-matched power-law
+synthetic at a chosen fraction of papers100M.
 
 This script is single-controller; each run partitions and shards the full
 graph host-side. For multi-controller pods,
@@ -55,7 +60,7 @@ def main(cfg: Config):
 
     from dgraph_tpu.comm import Communicator, make_graph_mesh
     from dgraph_tpu import partition as pt
-    from dgraph_tpu.plan import shard_vertex_data
+    from dgraph_tpu.data import memmap as mm
     from dgraph_tpu.train.checkpoint import cached_edge_plan
     from dgraph_tpu.models import GCN
     from dgraph_tpu.train.loop import init_params, make_train_step
@@ -71,10 +76,10 @@ def main(cfg: Config):
 
         if os.path.isdir(cfg.data_npz):
             # directory of .npy files: true memmaps, nothing loaded eagerly
-            z = {
-                k: np.load(os.path.join(cfg.data_npz, k + ".npy"), mmap_mode="r")
-                for k in ("edge_index", "features", "labels", "train_mask")
-            }
+            z = mm.open_memmap_dataset(
+                cfg.data_npz,
+                names=("edge_index", "features", "labels", "train_mask"),
+            )
         else:
             z = np.load(cfg.data_npz)  # .npz archive (eager)
         edge_index, feats = z["edge_index"], z["features"]
@@ -111,9 +116,14 @@ def main(cfg: Config):
     n_pad = plan_np.n_src_pad
 
     TimingReport.start("shard_data")
-    x = shard_vertex_data(np.asarray(feats)[ren.inv], ren.counts, n_pad)
-    y = shard_vertex_data(np.asarray(labels)[ren.inv].astype(np.int32), ren.counts, n_pad)
-    m = shard_vertex_data(np.asarray(train_mask).astype(np.float32)[ren.inv], ren.counts, n_pad)
+    # shard_rows reads each shard's rows page-sequentially from the (possibly
+    # memmapped) source without ever materializing feats[ren.inv] whole
+    shards = range(world)
+    x = mm.shard_rows(feats, ren.inv, ren.offsets, n_pad, shards, np.float32)
+    y = mm.shard_rows(labels, ren.inv, ren.offsets, n_pad, shards, np.int32)
+    m = mm.shard_rows(
+        np.asarray(train_mask, np.float32), ren.inv, ren.offsets, n_pad, shards
+    )
     TimingReport.stop("shard_data")
 
     dtype = jnp.bfloat16 if cfg.bfloat16 else None

@@ -114,3 +114,38 @@ def test_parallel_namespace():
 
     assert callable(parallel.halo_exchange)
     assert parallel.GRAPH_AXIS == "graph"
+
+
+def test_fused_scatter_variants(rng):
+    """Fused ReLU / sum+ReLU / sparse scatter vs dense-loop golden
+    (Fused_ReLU_Scatter_Kernel, Fused_Sum_Norm_Scatter_Kernel,
+    Sparse_Scatter_Kernel semantics)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from dgraph_tpu.ops import local as L
+
+    E, N, F = 200, 40, 8
+    ids = rng.integers(0, N, E).astype(np.int32)
+    v1 = rng.normal(size=(E, F)).astype(np.float32)
+    v2 = rng.normal(size=(E, F)).astype(np.float32)
+
+    exp = np.zeros((N, F), np.float32)
+    np.add.at(exp, ids, np.maximum(v1, 0))
+    np.testing.assert_allclose(
+        np.asarray(L.scatter_add_relu(jnp.asarray(v1), jnp.asarray(ids), N)),
+        exp, rtol=1e-5, atol=1e-5)
+
+    exp2 = np.zeros((N, F), np.float32)
+    np.add.at(exp2, ids, np.maximum(v1 + v2, 0))
+    np.testing.assert_allclose(
+        np.asarray(L.scatter_add_sum_relu(jnp.asarray(v1), jnp.asarray(v2), jnp.asarray(ids), N)),
+        exp2, rtol=1e-5, atol=1e-5)
+
+    # sparse: -1 rows dropped, accumulates into existing dst
+    sidx = ids.astype(np.int64).copy()
+    sidx[: E // 4] = -1
+    dst = rng.normal(size=(N, F)).astype(np.float32)
+    exp3 = dst.copy()
+    np.add.at(exp3, sidx[E // 4:], v1[E // 4:])
+    got3 = L.sparse_scatter_add(jnp.asarray(dst), jnp.asarray(sidx), jnp.asarray(v1))
+    np.testing.assert_allclose(np.asarray(got3), exp3, rtol=1e-5, atol=1e-5)

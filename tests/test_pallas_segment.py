@@ -55,3 +55,18 @@ def test_skewed_segments(rng):
     expected = np.zeros((N, F), np.float32)
     np.add.at(expected, ids, data)
     np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_relu_input_op(rng):
+    """input_op='relu' == relu-then-sum (Fused_ReLU_Scatter_Kernel parity)."""
+    E, N, F = 777, 128, 16
+    ids = np.sort(rng.integers(0, N, E)).astype(np.int32)
+    data = rng.normal(size=(E, F)).astype(np.float32)
+    got = sorted_segment_sum(
+        jnp.asarray(data), jnp.asarray(ids), N,
+        max_chunks_per_block=max_chunks_hint(ids, N), interpret=True,
+        input_op="relu",
+    )
+    expected = np.zeros((N, F), np.float32)
+    np.add.at(expected, ids, np.maximum(data, 0.0))
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5, atol=1e-5)
