@@ -181,7 +181,7 @@ def gather(
         full = jnp.concatenate([x, haloed], axis=0)
     else:
         full = x
-    return full[idx] * plan.edge_mask[:, None]
+    return local_ops.row_take(full, idx) * plan.edge_mask[:, None].astype(x.dtype)
 
 
 @_scoped("dgraph.scatter_sum")
@@ -201,7 +201,9 @@ def scatter_sum(
       edata: [e_pad, F] per-edge values.
     Returns: [n_pad, F] per-vertex sums for the requested side.
     """
-    edata = edata * plan.edge_mask[:, None]
+    # mask in the activation dtype — a f32 mask would silently upcast bf16
+    # edge tensors (and disable the bf16 kernel fast path below)
+    edata = edata * plan.edge_mask[:, None].astype(edata.dtype)
     idx = _side_index(plan, side)
     n_pad = _side_npad(plan, side)
     if side != plan.halo_side:
@@ -215,8 +217,13 @@ def scatter_sum(
         ):
             from dgraph_tpu.ops.pallas_segment import sorted_segment_sum
 
+            # bf16 activations already carry bf16 precision — take the fast
+            # single-pass MXU path; f32 gets faithful accumulation.
+            prec = "default" if edata.dtype == jnp.bfloat16 else "highest"
             return sorted_segment_sum(
-                edata, idx, n_pad, max_chunks_per_block=plan.scatter_mc
+                edata, idx, n_pad, max_chunks_per_block=plan.scatter_mc,
+                block_e=plan.scatter_block_e, block_n=plan.scatter_block_n,
+                precision=prec,
             )
         return local_ops.segment_sum(
             edata, idx, n_pad, indices_are_sorted=plan.owner_sorted

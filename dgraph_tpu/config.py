@@ -25,6 +25,11 @@ use_pallas_scatter: bool = _env_flag("DGRAPH_TPU_PALLAS_SCATTER", False)
 # float32). Models read this at construction time.
 default_compute_dtype: str = os.environ.get("DGRAPH_TPU_COMPUTE_DTYPE", "float32")
 
+# Column-chunk width for row gathers (ops.local.row_take). XLA's TPU
+# row-gather fast path covers one 128-lane tile; wider rows are gathered
+# in <=this many columns per pass. 0 disables splitting.
+gather_col_block: int = int(os.environ.get("DGRAPH_TPU_GATHER_COL_BLOCK", "128"))
+
 # Halo exchange lowering: 'auto' (ppermute neighbor rounds when the plan's
 # active peer-delta set is sparse, else one padded all_to_all),
 # 'all_to_all', or 'ppermute'.

@@ -57,14 +57,36 @@ def create_memmap_dataset(
 
 
 def open_memmap_dataset(path: str, names: Optional[Iterable[str]] = None) -> dict:
-    """Open a directory of ``.npy`` files read-only as memmaps."""
+    """Open a directory of ``.npy`` files read-only as memmaps.
+
+    When the :data:`_META` sidecar written by :func:`create_memmap_dataset`
+    is present it is the source of truth: it names the arrays (when
+    ``names`` is None) and each opened array is validated against its
+    recorded shape/dtype — catching a half-written or overwritten dataset
+    at open time instead of as silent garbage mid-training.
+    """
+    meta = {}
+    meta_path = os.path.join(path, _META)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
     if names is None:
-        names = [
+        names = sorted(meta) if meta else [
             f[: -len(".npy")] for f in sorted(os.listdir(path)) if f.endswith(".npy")
         ]
-    return {
+    arrays = {
         n: np.load(os.path.join(path, n + ".npy"), mmap_mode="r") for n in names
     }
+    for n, arr in arrays.items():
+        if n in meta:
+            want = (tuple(meta[n]["shape"]), np.dtype(meta[n]["dtype"]))
+            got = (arr.shape, arr.dtype)
+            if want != got:
+                raise ValueError(
+                    f"memmap dataset {path!r}: array {n!r} is {got}, "
+                    f"but {_META} records {want}"
+                )
+    return arrays
 
 
 def generate_chunked(

@@ -287,6 +287,8 @@ class HaloSpec:
         "homogeneous",
         "owner_sorted",
         "scatter_mc",
+        "scatter_block_e",
+        "scatter_block_n",
         "halo_deltas",
     )
 )
@@ -329,9 +331,15 @@ class EdgePlan:
     # Padded edge slots carry the out-of-range owner-side id n_pad (monotone
     # tail; dropped by scatter, clamped-and-masked by gather).
     owner_sorted: bool = True
-    # Pallas scheduling hint: max edge-chunks any (block_n=256) vertex block
-    # spans at block_e=256, maxed over shards (see ops.pallas_segment)
+    # Pallas scheduling hint: max edge-chunks any (scatter_block_n) vertex
+    # block spans at chunk size scatter_block_e, maxed over shards (see
+    # ops.pallas_segment). The block sizes the hint was computed FOR are
+    # recorded alongside so kernel invocation and hint cannot desynchronize
+    # (plans are pickled into the on-disk cache; a default drift would
+    # otherwise silently under-visit chunks).
     scatter_mc: int = 1
+    scatter_block_e: int = 512
+    scatter_block_n: int = 256
     # Static tuple of rank-deltas ((peer - rank) mod W) with nonzero halo
     # traffic anywhere in the mesh. When sparse (locality partitions), the
     # halo exchange can run as len(halo_deltas) ppermute rounds instead of a
@@ -591,11 +599,16 @@ def build_edge_plan(
         dst_idx_arr = to_padded(halo_side_local_idx.astype(np.int32), np.int32)
 
     owner_idx_arr = dst_idx_arr if edge_owner == "dst" else src_idx_arr
+    scatter_block_e, scatter_block_n = 512, 256  # v5e-tuned (ops.pallas_segment)
     if sort_edges:
         from dgraph_tpu.ops.pallas_segment import max_chunks_hint
 
         scatter_mc = max(
-            max_chunks_hint(owner_idx_arr[r], n_owner_pad) for r in range(W)
+            max_chunks_hint(
+                owner_idx_arr[r], n_owner_pad,
+                block_e=scatter_block_e, block_n=scatter_block_n,
+            )
+            for r in range(W)
         )
     else:
         scatter_mc = 1
@@ -616,6 +629,8 @@ def build_edge_plan(
         homogeneous=homogeneous,
         owner_sorted=sort_edges,
         scatter_mc=scatter_mc,
+        scatter_block_e=scatter_block_e,
+        scatter_block_n=scatter_block_n,
         halo_deltas=tuple(
             int(d)
             for d in np.unique((needer - sender) % W)

@@ -48,6 +48,11 @@ class Config:
     world_size: int = 0
     bfloat16: bool = True
     remat: bool = True
+    # partition/plan knobs — keep in lock-step with setup_comms.py (both
+    # feed the plan-cache fingerprint; a mismatch silently misses the
+    # offline-built cache and repeats the hours-long build)
+    partition_method: str = "greedy_bfs"
+    pad_multiple: int = 128
     plan_cache: str = "cache/plans"
     log_path: str = "logs/papers100m.jsonl"
 
@@ -105,12 +110,15 @@ def main(cfg: Config):
 
     V = feats.shape[0]
     TimingReport.start("partition")
-    new_edges, ren = pt.partition_graph(edge_index, V, world, method="greedy_bfs")
+    new_edges, ren = pt.partition_graph(
+        edge_index, V, world, method=cfg.partition_method
+    )
     TimingReport.stop("partition")
 
     TimingReport.start("plan_build")
     plan_np, layout = cached_edge_plan(
-        cfg.plan_cache, new_edges, ren.partition, world_size=world, pad_multiple=128
+        cfg.plan_cache, new_edges, ren.partition, world_size=world,
+        pad_multiple=cfg.pad_multiple,
     )
     TimingReport.stop("plan_build")
     n_pad = plan_np.n_src_pad
@@ -121,9 +129,9 @@ def main(cfg: Config):
     shards = range(world)
     x = mm.shard_rows(feats, ren.inv, ren.offsets, n_pad, shards, np.float32)
     y = mm.shard_rows(labels, ren.inv, ren.offsets, n_pad, shards, np.int32)
-    m = mm.shard_rows(
-        np.asarray(train_mask, np.float32), ren.inv, ren.offsets, n_pad, shards
-    )
+    # dtype=np.float32 converts per shard — the bool memmap is never
+    # materialized as a full V-length float array host-side
+    m = mm.shard_rows(train_mask, ren.inv, ren.offsets, n_pad, shards, np.float32)
     TimingReport.stop("shard_data")
 
     dtype = jnp.bfloat16 if cfg.bfloat16 else None

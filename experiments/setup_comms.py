@@ -54,10 +54,19 @@ def main(cfg: Config):
 
     if os.path.isdir(cfg.data):
         z = open_memmap_dataset(cfg.data, names=["edge_index"])
+        feat_path = os.path.join(cfg.data, "features.npy")
+        if os.path.exists(feat_path):
+            z["features"] = np.load(feat_path, mmap_mode="r")
     else:
         z = np.load(cfg.data)
     edge_index = np.asarray(z["edge_index"])
-    V = int(edge_index.max()) + 1
+    # V must match what training uses (feature row count, which can exceed
+    # max edge endpoint when top-id vertices are isolated) or the plan-cache
+    # fingerprints diverge and the offline build is silently wasted.
+    if "features" in getattr(z, "files", z):
+        V = int(z["features"].shape[0])
+    else:
+        V = int(edge_index.max()) + 1
 
     t0 = time.perf_counter()
     new_edges, ren = pt.partition_graph(

@@ -57,6 +57,60 @@ def test_skewed_segments(rng):
     np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4, atol=1e-4)
 
 
+def test_grad_is_gather_transpose(rng):
+    """VJP == g[ids] with OOB ids dropped (gather-bwd = scatter-sum duality,
+    the reference pins the same pair in ``tests/test_NCCLCommPlan.py``)."""
+    import jax
+
+    E, N, F = 600, 100, 8
+    ids = np.sort(rng.integers(0, N, E)).astype(np.int32)
+    ids[-40:] = N + 1  # padded edges
+    data = rng.normal(size=(E, F)).astype(np.float32)
+    g_out = rng.normal(size=(N, F)).astype(np.float32)
+    mc = max_chunks_hint(ids, N)
+
+    def loss(d):
+        out = sorted_segment_sum(
+            d, jnp.asarray(ids), N, max_chunks_per_block=mc, interpret=True
+        )
+        return (out * g_out).sum()
+
+    got = jax.grad(loss)(jnp.asarray(data))
+    expected = np.zeros_like(data)
+    expected[:-40] = g_out[ids[:-40]]
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-5, atol=1e-5)
+
+
+def test_grad_relu_input_op(rng):
+    import jax
+
+    E, N, F = 300, 50, 4
+    ids = np.sort(rng.integers(0, N, E)).astype(np.int32)
+    data = rng.normal(size=(E, F)).astype(np.float32)
+    g_out = rng.normal(size=(N, F)).astype(np.float32)
+    mc = max_chunks_hint(ids, N)
+
+    def loss_pallas(d):
+        return (
+            sorted_segment_sum(
+                d, jnp.asarray(ids), N, max_chunks_per_block=mc,
+                interpret=True, input_op="relu",
+            ) * g_out
+        ).sum()
+
+    def loss_ref(d):
+        import jax.nn
+
+        out = jax.ops.segment_sum(jax.nn.relu(d), jnp.asarray(ids), num_segments=N)
+        return (out * g_out).sum()
+
+    import jax
+
+    got = jax.grad(loss_pallas)(jnp.asarray(data))
+    want = jax.grad(loss_ref)(jnp.asarray(data))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
 def test_fused_relu_input_op(rng):
     """input_op='relu' == relu-then-sum (Fused_ReLU_Scatter_Kernel parity)."""
     E, N, F = 777, 128, 16
