@@ -1,4 +1,4 @@
 from dgraph_tpu.data.graph import DistributedGraph
-from dgraph_tpu.data import memmap, synthetic
+from dgraph_tpu.data import memmap, ogbn, synthetic
 
-__all__ = ["DistributedGraph", "memmap", "synthetic"]
+__all__ = ["DistributedGraph", "memmap", "ogbn", "synthetic"]

@@ -167,10 +167,13 @@ def _make_sss(num_segments, max_chunks_per_block, block_e, block_n, interpret,
         segment_ids, data = res
         # column-chunked take: the same >128-lane row-gather cliff the
         # forward path avoids (ops.local.row_take) applies to the grad
-        # gather — keep every piece on XLA's one-tile fast path
+        # gather — keep every piece on XLA's one-tile fast path. Uses the
+        # same config knob as row_take so the split policy can't drift.
+        from dgraph_tpu import config as _cfg
+
         F = g.shape[-1]
-        cb = 128
-        if F <= cb:
+        cb = _cfg.gather_col_block
+        if not cb or F <= cb:
             gd = jnp.take(g, segment_ids, axis=0, mode="fill", fill_value=0)
         else:
             gd = jnp.concatenate(

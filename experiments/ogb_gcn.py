@@ -23,6 +23,8 @@ import numpy as np
 @dataclasses.dataclass
 class DataConfig:
     path: Optional[str] = None  # npz with edge_index [2,E], features, labels, masks
+    ogb_name: Optional[str] = None  # e.g. 'ogbn-arxiv' — needs the ogb
+    # package OR path pointing at an export_npz() artifact (data/ogbn.py)
     num_nodes: int = 5000  # synthetic SBM size when path is None
     num_classes: int = 8
     feat_dim: int = 64
@@ -45,6 +47,24 @@ class Config:
 
 
 def load_data(cfg: DataConfig):
+    if cfg.ogb_name:
+        from dgraph_tpu.data import ogbn
+
+        arrs = (
+            ogbn.from_npz(cfg.path) if cfg.path
+            else ogbn.load_ogb_arrays(cfg.ogb_name)
+        )
+        return {
+            "edge_index": np.asarray(arrs["edge_index"]),
+            "features": np.asarray(arrs["features"]),
+            "labels": np.asarray(arrs["labels"]),
+            "masks": {
+                k.removesuffix("_mask"): np.asarray(v)
+                for k, v in arrs.items()
+                if k.endswith("_mask")
+            },
+            "num_classes": int(np.asarray(arrs["labels"]).max()) + 1,
+        }
     if cfg.path:
         z = np.load(cfg.path)
         masks = {

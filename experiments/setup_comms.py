@@ -63,10 +63,20 @@ def main(cfg: Config):
     # V must match what training uses (feature row count, which can exceed
     # max edge endpoint when top-id vertices are isolated) or the plan-cache
     # fingerprints diverge and the offline build is silently wasted.
-    if "features" in getattr(z, "files", z):
-        V = int(z["features"].shape[0])
-    else:
-        V = int(edge_index.max()) + 1
+    def _num_feature_rows(z):
+        if isinstance(z, dict):
+            return int(z["features"].shape[0]) if "features" in z else None
+        if "features" not in z.files:
+            return None
+        # .npz: read just the member's .npy header — z["features"] would
+        # decompress the whole (papers100M-scale) array to learn its shape
+        with z.zip.open("features.npy") as f:
+            version = np.lib.format.read_magic(f)
+            shape, _, _ = np.lib.format._read_array_header(f, version)
+        return int(shape[0])
+
+    n_rows = _num_feature_rows(z)
+    V = n_rows if n_rows is not None else int(edge_index.max()) + 1
 
     t0 = time.perf_counter()
     new_edges, ren = pt.partition_graph(

@@ -1,0 +1,149 @@
+"""OGB ingestion adapter: gated ogb import, npz/memmap export format,
+processed-graph cache, lead-first sentinel.
+
+The ogb package isn't in this image, so the package path is tested with a
+stub module injected into sys.modules — the adapter only touches
+``NodePropPredDataset(name, root)``, ``ds[0]`` and ``ds.get_idx_split()``
+(the reference wrapper's exact surface, ``ogbn_datasets.py:86-95``).
+"""
+
+import os
+import pickle
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.data import ogbn
+
+
+def _fake_arrays(V=60, E=300, F=8, C=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "edge_index": rng.integers(0, V, (2, E)).astype(np.int64),
+        "features": rng.normal(size=(V, F)).astype(np.float32),
+        "labels": rng.integers(0, C, V).astype(np.int32),
+        "num_nodes": V,
+    }
+
+
+class _FakeOGBDataset:
+    def __init__(self, name, root=None):
+        self.arrs = _fake_arrays()
+
+    def __getitem__(self, i):
+        a = self.arrs
+        graph = {
+            "edge_index": a["edge_index"],
+            "node_feat": a["features"],
+            "num_nodes": a["num_nodes"],
+        }
+        # ogb returns [V, 1] float labels for some datasets; exercise the
+        # squeeze + NaN handling
+        labels = a["labels"].astype(np.float64)[:, None].copy()
+        labels[0, 0] = np.nan
+        return graph, labels
+
+    def get_idx_split(self):
+        V = self.arrs["num_nodes"]
+        return {
+            "train": np.arange(0, V // 2),
+            "valid": np.arange(V // 2, 3 * V // 4),
+            "test": np.arange(3 * V // 4, V),
+        }
+
+
+@pytest.fixture
+def fake_ogb(monkeypatch):
+    mod = types.ModuleType("ogb")
+    sub = types.ModuleType("ogb.nodeproppred")
+    sub.NodePropPredDataset = _FakeOGBDataset
+    mod.nodeproppred = sub
+    monkeypatch.setitem(sys.modules, "ogb", mod)
+    monkeypatch.setitem(sys.modules, "ogb.nodeproppred", sub)
+    yield
+
+
+def test_import_gate_message():
+    with pytest.raises(ImportError, match="export_npz"):
+        ogbn.load_ogb_arrays("ogbn-arxiv")
+
+
+def test_unsupported_name():
+    with pytest.raises(ValueError, match="unsupported"):
+        ogbn.load_ogb_arrays("ogbn-mag")
+
+
+def test_load_with_fake_ogb(fake_ogb):
+    arrs = ogbn.load_ogb_arrays("ogbn-arxiv")
+    assert arrs["features"].shape == (60, 8)
+    assert arrs["labels"].dtype == np.int32
+    assert arrs["labels"][0] == 0  # NaN -> class 0
+    assert arrs["train_mask"].sum() == 30
+    assert arrs["valid_mask"].sum() == 15
+    assert arrs["test_mask"].sum() == 15
+
+
+def test_export_npz_roundtrip(fake_ogb, tmp_path):
+    p = str(tmp_path / "arxiv.npz")
+    ogbn.export_npz("ogbn-arxiv", p)
+    back = ogbn.from_npz(p)
+    assert back["num_nodes"] == 60
+    assert set(back) >= {"edge_index", "features", "labels", "train_mask"}
+    np.testing.assert_array_equal(
+        back["features"], ogbn.load_ogb_arrays("ogbn-arxiv")["features"]
+    )
+
+
+def test_distributed_dataset_cache(fake_ogb, tmp_path):
+    cache_dir = str(tmp_path / "cache")
+    ds = ogbn.DistributedOGBDataset(
+        "ogbn-arxiv", world_size=2, cache_dir=cache_dir, pad_multiple=8
+    )
+    assert ds.graph.world_size == 2
+    assert ds.plan.world_size == 2
+    b = ds.batch("train")
+    assert b["x"].shape[0] == 2  # [W, n_pad, F]
+    # second construction must come from the pickle cache, not ogb: break
+    # the stub to prove it
+    sys.modules["ogb.nodeproppred"].NodePropPredDataset = None
+    ds2 = ogbn.DistributedOGBDataset(
+        "ogbn-arxiv", world_size=2, cache_dir=cache_dir, pad_multiple=8
+    )
+    np.testing.assert_array_equal(ds2.graph.features, ds.graph.features)
+
+
+def test_distributed_dataset_from_npz(fake_ogb, tmp_path):
+    p = str(tmp_path / "arxiv.npz")
+    ogbn.export_npz("ogbn-arxiv", p)
+    del sys.modules["ogb"], sys.modules["ogb.nodeproppred"]
+    ds = ogbn.DistributedOGBDataset(
+        "ogbn-arxiv", world_size=2, data_path=p,
+        cache_dir=str(tmp_path / "c2"), pad_multiple=8,
+    )
+    assert ds.graph.num_nodes == 60
+
+
+def test_lead_first_sentinel(tmp_path):
+    path = str(tmp_path / "artifact.bin")
+    calls = []
+
+    def build(p):
+        calls.append(p)
+        with open(p, "wb") as f:
+            f.write(b"x")
+
+    ogbn.lead_first(path, build, is_lead=True)
+    assert calls == [path]
+    # follower: sentinel exists, build must NOT run
+    ogbn.lead_first(path, build, is_lead=False)
+    assert calls == [path]
+
+
+def test_lead_first_follower_timeout(tmp_path):
+    with pytest.raises(TimeoutError):
+        ogbn.lead_first(
+            str(tmp_path / "never.bin"), lambda p: None, is_lead=False,
+            poll_s=0.01, timeout_s=0.05,
+        )
