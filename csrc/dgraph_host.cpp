@@ -88,6 +88,278 @@ void greedy_bfs_partition(const int64_t* src, const int64_t* dst,
     if (out_part[v] < 0) out_part[v] = world_size - 1;
 }
 
+namespace {
+
+// Weighted undirected graph in CSR form for the multilevel partitioner.
+struct WGraph {
+  int64_t nv = 0;
+  std::vector<int64_t> indptr;
+  std::vector<int64_t> adj;   // neighbor ids (deduped, no self loops)
+  std::vector<int64_t> ew;    // edge weights (parallel-edge multiplicity)
+  std::vector<int64_t> vw;    // vertex weights (coarse vertices aggregate)
+};
+
+// Build the level-0 weighted graph from a directed edge list: symmetrize,
+// drop self loops, merge parallel edges into weights.
+WGraph build_wgraph(const int64_t* src, const int64_t* dst, int64_t num_edges,
+                    int64_t num_vertices) {
+  WGraph g;
+  g.nv = num_vertices;
+  g.vw.assign(num_vertices, 1);
+  std::vector<int64_t> deg(num_vertices, 0);
+  for (int64_t e = 0; e < num_edges; ++e) {
+    if (src[e] == dst[e]) continue;
+    ++deg[src[e]];
+    ++deg[dst[e]];
+  }
+  g.indptr.assign(num_vertices + 1, 0);
+  for (int64_t v = 0; v < num_vertices; ++v) g.indptr[v + 1] = g.indptr[v] + deg[v];
+  std::vector<int64_t> raw(g.indptr[num_vertices]);
+  std::vector<int64_t> cur(g.indptr.begin(), g.indptr.end() - 1);
+  for (int64_t e = 0; e < num_edges; ++e) {
+    if (src[e] == dst[e]) continue;
+    raw[cur[src[e]]++] = dst[e];
+    raw[cur[dst[e]]++] = src[e];
+  }
+  // dedup neighbors per vertex, accumulating multiplicity as weight
+  g.adj.reserve(raw.size());
+  g.ew.reserve(raw.size());
+  std::vector<int64_t> new_indptr(num_vertices + 1, 0);
+  for (int64_t v = 0; v < num_vertices; ++v) {
+    int64_t lo = g.indptr[v], hi = g.indptr[v + 1];
+    std::sort(raw.begin() + lo, raw.begin() + hi);
+    for (int64_t k = lo; k < hi;) {
+      int64_t n = raw[k], w = 0;
+      while (k < hi && raw[k] == n) { ++w; ++k; }
+      g.adj.push_back(n);
+      g.ew.push_back(w);
+    }
+    new_indptr[v + 1] = static_cast<int64_t>(g.adj.size());
+  }
+  g.indptr = std::move(new_indptr);
+  return g;
+}
+
+// Heavy-edge matching: returns match[v] (== v for unmatched/self-matched)
+// and the number of coarse vertices; cmap[v] = coarse id.
+int64_t heavy_edge_matching(const WGraph& g, std::mt19937_64& rng,
+                            std::vector<int64_t>& cmap) {
+  // Visit low-degree vertices first (random within a degree class) and
+  // score candidates by edge weight normalized by the partner's vertex
+  // weight. Plain max-weight matching merges across weak bridges when all
+  // weights tie (level 0) — bridge endpoints tend to have higher degree,
+  // so degree-ordered visiting lets cluster-internal vertices pair up
+  // before a bridge endpoint can grab them, and the normalization keeps
+  // supernodes from snowballing.
+  std::vector<int64_t> order(g.nv);
+  for (int64_t i = 0; i < g.nv; ++i) order[i] = i;
+  std::shuffle(order.begin(), order.end(), rng);
+  std::stable_sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    return (g.indptr[a + 1] - g.indptr[a]) < (g.indptr[b + 1] - g.indptr[b]);
+  });
+  std::vector<int64_t> match(g.nv, -1);
+  for (int64_t idx = 0; idx < g.nv; ++idx) {
+    int64_t v = order[idx];
+    if (match[v] >= 0) continue;
+    int64_t best = -1;
+    double best_score = 0.0;
+    for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k) {
+      int64_t n = g.adj[k];
+      if (match[n] >= 0) continue;
+      double score = double(g.ew[k]) / double(g.vw[n]);
+      if (score > best_score) { best = n; best_score = score; }
+    }
+    if (best >= 0) { match[v] = best; match[best] = v; }
+    else match[v] = v;
+  }
+  cmap.assign(g.nv, -1);
+  int64_t nc = 0;
+  for (int64_t v = 0; v < g.nv; ++v) {
+    if (cmap[v] >= 0) continue;
+    cmap[v] = nc;
+    if (match[v] != v) cmap[match[v]] = nc;
+    ++nc;
+  }
+  return nc;
+}
+
+// Contract g by cmap into a coarse weighted graph.
+WGraph contract(const WGraph& g, const std::vector<int64_t>& cmap, int64_t nc) {
+  WGraph c;
+  c.nv = nc;
+  c.vw.assign(nc, 0);
+  for (int64_t v = 0; v < g.nv; ++v) c.vw[cmap[v]] += g.vw[v];
+  // gather coarse edges per coarse vertex, then dedup-accumulate
+  std::vector<std::pair<int64_t, int64_t>> edges;  // (enc(cu,cv), w) cu<cv
+  edges.reserve(g.adj.size() / 2);
+  for (int64_t v = 0; v < g.nv; ++v) {
+    int64_t cu = cmap[v];
+    for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k) {
+      int64_t cv = cmap[g.adj[k]];
+      if (cu < cv) edges.emplace_back(cu * nc + cv, g.ew[k]);
+    }
+  }
+  std::sort(edges.begin(), edges.end());
+  std::vector<int64_t> deg(nc, 0);
+  std::vector<std::pair<int64_t, int64_t>> merged;  // (enc, w)
+  merged.reserve(edges.size());
+  for (size_t i = 0; i < edges.size();) {
+    int64_t enc = edges[i].first, w = 0;
+    while (i < edges.size() && edges[i].first == enc) { w += edges[i].second; ++i; }
+    merged.emplace_back(enc, w);
+    ++deg[enc / nc];
+    ++deg[enc % nc];
+  }
+  c.indptr.assign(nc + 1, 0);
+  for (int64_t v = 0; v < nc; ++v) c.indptr[v + 1] = c.indptr[v] + deg[v];
+  c.adj.assign(c.indptr[nc], 0);
+  c.ew.assign(c.indptr[nc], 0);
+  std::vector<int64_t> cur(c.indptr.begin(), c.indptr.end() - 1);
+  for (auto& [enc, w] : merged) {
+    int64_t a = enc / nc, b = enc % nc;
+    c.adj[cur[a]] = b; c.ew[cur[a]++] = w;
+    c.adj[cur[b]] = a; c.ew[cur[b]++] = w;
+  }
+  return c;
+}
+
+// Weighted greedy region growing on the (coarsest) graph — METIS-style
+// GGGP: always absorb the frontier vertex with the STRONGEST connection to
+// the growing region. A DFS stack here is catastrophically order-sensitive
+// (it dives along weak chain edges, stranding heavy partners on the stack);
+// the max-connection heap follows the weight structure instead.
+void initial_partition(const WGraph& g, int32_t world_size, std::mt19937_64& rng,
+                       std::vector<int32_t>& part) {
+  part.assign(g.nv, -1);
+  int64_t total_vw = 0;
+  for (auto w : g.vw) total_vw += w;
+  const int64_t cap = (total_vw + world_size - 1) / world_size;
+  std::vector<int64_t> order(g.nv);
+  for (int64_t i = 0; i < g.nv; ++i) order[i] = i;
+  std::shuffle(order.begin(), order.end(), rng);
+  int64_t seed_ptr = 0;
+  std::vector<int64_t> conn(g.nv, 0);
+  // lazy max-heap of (connection-to-region, vertex); stale entries skipped
+  std::priority_queue<std::pair<int64_t, int64_t>> heap;
+  for (int32_t r = 0; r < world_size; ++r) {
+    int64_t weight = 0;
+    while (!heap.empty()) heap.pop();
+    std::fill(conn.begin(), conn.end(), 0);
+    while (weight < cap) {
+      int64_t v = -1;
+      while (!heap.empty()) {
+        auto [w, u] = heap.top();
+        heap.pop();
+        if (part[u] < 0 && w == conn[u]) { v = u; break; }
+      }
+      if (v < 0) {
+        while (seed_ptr < g.nv && part[order[seed_ptr]] >= 0) ++seed_ptr;
+        if (seed_ptr >= g.nv) break;
+        v = order[seed_ptr];
+      }
+      part[v] = r;
+      weight += g.vw[v];
+      for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k) {
+        int64_t n = g.adj[k];
+        if (part[n] < 0) {
+          conn[n] += g.ew[k];
+          heap.emplace(conn[n], n);
+        }
+      }
+    }
+  }
+  for (int64_t v = 0; v < g.nv; ++v)
+    if (part[v] < 0) part[v] = world_size - 1;
+}
+
+// Greedy boundary refinement (FM-lite): move boundary vertices to the
+// neighbor partition with the largest positive cut gain, under a balance
+// cap. A few passes per level.
+void refine(const WGraph& g, int32_t world_size, std::vector<int32_t>& part,
+            int passes, double imbalance) {
+  int64_t total_vw = 0;
+  for (auto w : g.vw) total_vw += w;
+  const int64_t cap =
+      static_cast<int64_t>((double(total_vw) / world_size) * imbalance) + 1;
+  std::vector<int64_t> pw(world_size, 0);
+  for (int64_t v = 0; v < g.nv; ++v) pw[part[v]] += g.vw[v];
+  std::vector<int64_t> conn(world_size, 0);
+  for (int p = 0; p < passes; ++p) {
+    int64_t moves = 0;
+    for (int64_t v = 0; v < g.nv; ++v) {
+      int32_t pv = part[v];
+      bool boundary = false;
+      for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k)
+        if (part[g.adj[k]] != pv) { boundary = true; break; }
+      if (!boundary) continue;
+      std::fill(conn.begin(), conn.end(), 0);
+      for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k)
+        conn[part[g.adj[k]]] += g.ew[k];
+      int32_t best = pv;
+      int64_t best_gain = 0;
+      for (int32_t r = 0; r < world_size; ++r) {
+        if (r == pv || pw[r] + g.vw[v] > cap) continue;
+        int64_t gain = conn[r] - conn[pv];
+        if (gain > best_gain) { best = r; best_gain = gain; }
+      }
+      if (best != pv) {
+        pw[pv] -= g.vw[v];
+        pw[best] += g.vw[v];
+        part[v] = best;
+        ++moves;
+      }
+    }
+    if (!moves) break;
+  }
+}
+
+}  // namespace
+
+// Multilevel k-way partition (the METIS-shaped algorithm the reference
+// leans on via pymetis: coarsen by heavy-edge matching, partition the
+// coarsest graph, project back with boundary refinement at every level).
+void multilevel_partition(const int64_t* src, const int64_t* dst,
+                          int64_t num_edges, int64_t num_vertices,
+                          int32_t world_size, uint64_t seed,
+                          int32_t* out_part) {
+  std::mt19937_64 rng(seed);
+  std::vector<WGraph> levels;
+  std::vector<std::vector<int64_t>> cmaps;
+  levels.push_back(build_wgraph(src, dst, num_edges, num_vertices));
+  // coarsen until ~16 coarse vertices per partition: deep enough that
+  // locality clusters contract to single vertices (the initial partition
+  // then only cuts inter-cluster links), shallow enough to stay balanced
+  const int64_t coarse_target =
+      std::max<int64_t>(static_cast<int64_t>(world_size) * 16, 64);
+  while (levels.back().nv > coarse_target) {
+    std::vector<int64_t> cmap;
+    int64_t nc = heavy_edge_matching(levels.back(), rng, cmap);
+    if (nc > levels.back().nv * 95 / 100) break;  // matching stalled
+    WGraph coarse = contract(levels.back(), cmap, nc);
+    cmaps.push_back(std::move(cmap));
+    levels.push_back(std::move(coarse));
+  }
+  std::vector<int32_t> part;
+  initial_partition(levels.back(), world_size, rng, part);
+  refine(levels.back(), world_size, part, /*passes=*/4, /*imbalance=*/1.03);
+  for (int64_t l = static_cast<int64_t>(cmaps.size()) - 1; l >= 0; --l) {
+    const std::vector<int64_t>& cmap = cmaps[l];
+    std::vector<int32_t> fine(levels[l].nv);
+    for (int64_t v = 0; v < levels[l].nv; ++v) fine[v] = part[cmap[v]];
+    part = std::move(fine);
+    refine(levels[l], world_size, part, /*passes=*/2, /*imbalance=*/1.03);
+  }
+  std::memcpy(out_part, part.data(), num_vertices * sizeof(int32_t));
+}
+
+extern "C" void multilevel_partition_c(const int64_t* src, const int64_t* dst,
+                                       int64_t num_edges, int64_t num_vertices,
+                                       int32_t world_size, uint64_t seed,
+                                       int32_t* out_part) {
+  multilevel_partition(src, dst, num_edges, num_vertices, world_size, seed,
+                       out_part);
+}
+
 // Deduplicate (key, value) pairs encoded as key*stride+value, sorted.
 // Returns the number of unique pairs written to out (caller allocates n).
 int64_t unique_encoded_pairs(const int64_t* keys, const int64_t* vals,

@@ -53,6 +53,11 @@ def _load():
             ctypes.c_uint64, i32p,
         ]
         lib.greedy_bfs_partition.restype = None
+        lib.multilevel_partition_c.argtypes = [
+            i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_uint64, i32p,
+        ]
+        lib.multilevel_partition_c.restype = None
         lib.unique_encoded_pairs.argtypes = [
             i64p, i64p, ctypes.c_int64, ctypes.c_int64, i64p,
         ]
@@ -76,6 +81,21 @@ def greedy_bfs_partition(
     dst = np.ascontiguousarray(edge_index[1], np.int64)
     out = np.empty(num_nodes, np.int32)
     lib.greedy_bfs_partition(src, dst, len(src), num_nodes, world_size, seed, out)
+    return out
+
+
+def multilevel_partition(
+    edge_index: np.ndarray, num_nodes: int, world_size: int, seed: int = 0
+) -> np.ndarray:
+    """METIS-shaped multilevel k-way partition (csrc/dgraph_host.cpp):
+    heavy-edge-matching coarsening, weighted greedy initial partition,
+    boundary (FM-lite) refinement per uncoarsening level."""
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    src = np.ascontiguousarray(edge_index[0], np.int64)
+    dst = np.ascontiguousarray(edge_index[1], np.int64)
+    out = np.empty(num_nodes, np.int32)
+    lib.multilevel_partition_c(src, dst, len(src), num_nodes, world_size, seed, out)
     return out
 
 
