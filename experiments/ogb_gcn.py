@@ -29,7 +29,7 @@ class DataConfig:
     num_classes: int = 8
     feat_dim: int = 64
     avg_degree: float = 10.0
-    partition: str = "rcm"
+    partition: str = "multilevel"  # METIS-shaped native partitioner
 
 
 @dataclasses.dataclass
