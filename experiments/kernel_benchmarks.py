@@ -1,0 +1,137 @@
+"""Hot-op microbenchmarks on the local accelerator: row gather, segment
+sum (XLA vs Pallas), one-hot scatter variants.
+
+The kernel-level companion of ``comm_benchmarks.py`` (together they mirror
+the reference's ``experiments/Benchmarks`` suite, ``TestNCCL.py:23-111``),
+pointed at the per-chip primitives instead of the wire.
+
+Timing protocol (see ``bench.py``): on the tunneled single-chip setup
+``block_until_ready`` is not a reliable completion barrier and identical
+dispatches can be memoized, so every op is timed as an in-jit ``lax.scan``
+of n iterations with a scalar fetch, reporting the delta between two scan
+lengths (per-call RPC latency cancels).
+
+Usage:
+    python experiments/kernel_benchmarks.py --num_nodes 169343 \
+        --num_edges 2332486 --feat_dims 128,256 --out logs/kernels.jsonl
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from functools import partial
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    """Per-chip hot-op microbenchmarks."""
+
+    num_nodes: int = 169_343  # ogbn-arxiv scale
+    num_edges: int = 2_332_486
+    feat_dims: str = "128,256"
+    reps: int = 3
+    n_long: int = 11
+    out: Optional[str] = "logs/kernel_benchmarks.jsonl"
+    pallas: bool = True  # include the Pallas sorted-segment-sum variants
+
+
+def _bench(op, arg, *, reps: int, n_long: int):
+    """Median positive delta (ms per op) between 1- and n_long-iteration
+    in-jit scans, each forced complete by a scalar fetch."""
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnames="n")
+    def loop(a, s, n):
+        def body(acc, _):
+            out = op(a + acc)
+            return acc + out.ravel()[0].astype(jnp.float32) * 1e-20, None
+
+        acc, _ = jax.lax.scan(body, s, None, length=n)
+        return acc
+
+    float(loop(arg, jnp.float32(0), 1))
+    float(loop(arg, jnp.float32(0), n_long))
+    deltas = []
+    for r in range(reps):
+        t0 = time.perf_counter()
+        float(loop(arg, jnp.float32(r + 1), 1))
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(loop(arg, jnp.float32(r + 101), n_long))
+        tl = time.perf_counter() - t0
+        deltas.append((tl - t1) / (n_long - 1) * 1000.0)
+    pos = sorted(d for d in deltas if d > 0)
+    return pos[len(pos) // 2] if pos else max(deltas)
+
+
+def main(cfg: Config):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from dgraph_tpu.ops import local as local_ops
+    from dgraph_tpu.ops.pallas_segment import max_chunks_hint, sorted_segment_sum
+
+    records = []
+
+    def record(**kw):
+        kw["ts"] = time.time()
+        records.append(kw)
+        print(json.dumps(kw))
+
+    rng = np.random.default_rng(0)
+    V, E = cfg.num_nodes, cfg.num_edges
+    N = ((V + 127) // 128) * 128
+    E_pad = ((E + 127) // 128) * 128
+    idx = jnp.asarray(rng.integers(0, V, E_pad).astype(np.int32))
+    sids_np = np.sort(rng.integers(0, V, E_pad)).astype(np.int32)
+    sids = jnp.asarray(sids_np)
+    on_tpu = jax.default_backend() == "tpu"
+
+    for F in [int(f) for f in cfg.feat_dims.split(",")]:
+        x = jnp.asarray(rng.standard_normal((N, F)), jnp.float32)
+        ed = jnp.asarray(rng.standard_normal((E_pad, F)), jnp.float32)
+        bench = partial(_bench, reps=cfg.reps, n_long=cfg.n_long)
+
+        t = bench(lambda a: a[idx], x)
+        record(op="gather_plain", F=F, ms=round(t, 3),
+               gbps=round(E_pad * F * 4 / t / 1e6, 1))
+        t = bench(lambda a: local_ops.row_take(a, idx, col_block=128), x)
+        record(op="gather_col_split", F=F, ms=round(t, 3),
+               gbps=round(E_pad * F * 4 / t / 1e6, 1))
+        t = bench(
+            lambda a: local_ops.segment_sum(a, sids, N, indices_are_sorted=True), ed
+        )
+        record(op="segment_sum_xla", F=F, ms=round(t, 3),
+               gbps=round(E_pad * F * 4 / t / 1e6, 1))
+        if cfg.pallas and on_tpu:
+            mc = max_chunks_hint(sids_np, N)
+            for prec in ("highest", "default"):
+                t = bench(
+                    lambda a, prec=prec: sorted_segment_sum(
+                        a, sids, N, max_chunks_per_block=mc, precision=prec
+                    ),
+                    ed,
+                )
+                record(op=f"segment_sum_pallas_{prec}", F=F, ms=round(t, 3),
+                       gbps=round(E_pad * F * 4 / t / 1e6, 1))
+
+    if cfg.out:
+        os.makedirs(os.path.dirname(cfg.out) or ".", exist_ok=True)
+        with open(cfg.out, "a") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    import os as _os, sys as _sys
+
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+    from dgraph_tpu.utils.cli import parse_config
+
+    main(parse_config(Config))
