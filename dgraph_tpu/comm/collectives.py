@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dgraph_tpu.plan import EdgePlan, HaloSpec
+from dgraph_tpu.plan import EdgePlan, HaloSpec, pick_halo_impl
 from dgraph_tpu.ops import local as local_ops
 
 
@@ -52,10 +52,9 @@ def _use_ppermute(axis_name, deltas) -> bool:
         return True
     if impl == "all_to_all":
         return False
-    # auto: neighbor rounds win when the peer set is sparse (locality
-    # partitions on mesh-like graphs); all_to_all wins all-pairs traffic
+    # auto: shared cost model with the plan builder's logged pick
     W = jax.lax.psum(1, axis_name)
-    return 0 < len(deltas) <= max(1, W // 2)
+    return pick_halo_impl(int(W), deltas) == "ppermute"
 
 
 @_scoped("dgraph.halo_exchange")

@@ -35,7 +35,10 @@ class DistributedGraph:
     plan: EdgePlan
     layout: EdgePlanLayout
     features: np.ndarray  # [W, n_pad, F]
-    labels: Optional[np.ndarray]  # [W, n_pad] int32
+    # [W, n_pad] int32 class ids, or [W, n_pad, C] float32 multi-label
+    # targets (ogbn-proteins); float inputs keep their dtype through
+    # from_global for BCE losses
+    labels: Optional[np.ndarray]
     masks: dict  # split name -> [W, n_pad] f32
     vertex_mask: np.ndarray  # [W, n_pad] f32: 1.0 for real vertices
     edge_weight: Optional[np.ndarray] = None  # [W, e_pad] f32
@@ -71,11 +74,18 @@ class DistributedGraph:
         feats = shard_vertex_data(
             np.asarray(features)[ren.inv], ren.counts, n_pad
         ).astype(np.float32)
-        lab = (
-            shard_vertex_data(np.asarray(labels)[ren.inv].astype(np.int32), ren.counts, n_pad)
-            if labels is not None
-            else None
-        )
+        if labels is not None:
+            lab_arr = np.asarray(labels)
+            # integer class ids -> int32; float arrays (e.g. ogbn-proteins'
+            # [V, 112] multi-label targets) keep float32 for BCE losses
+            lab_dtype = (
+                np.float32 if np.issubdtype(lab_arr.dtype, np.floating) else np.int32
+            )
+            lab = shard_vertex_data(
+                lab_arr[ren.inv].astype(lab_dtype), ren.counts, n_pad
+            )
+        else:
+            lab = None
         m = {}
         if masks:
             for k, v in masks.items():

@@ -75,15 +75,31 @@ def load_ogb_arrays(name: str, root: str = "dataset") -> dict:
     graph, labels = ds[0]
     split_idx = ds.get_idx_split()
     num_nodes = int(graph["num_nodes"])
-    labels = np.asarray(labels).squeeze()
-    # papers100M labels are float with NaN on unlabeled nodes (reference
-    # handles the same in its loaders); class 0 + loss mask is equivalent
-    if np.issubdtype(labels.dtype, np.floating):
-        labels = np.where(np.isnan(labels), 0, labels)
+    edge_index = np.asarray(graph["edge_index"], dtype=np.int64)
+    if name == "ogbn-proteins":
+        # proteins ships no node features (edge features only) and [V, 112]
+        # multi-label float targets; the reference carries a per-dataset
+        # num_classes table for it (ogbn_datasets.py:25-37). Node features:
+        # species one-hot + log-degree (the standard featureless recipe).
+        species = np.asarray(graph["node_species"]).squeeze()
+        uniq, inv = np.unique(species, return_inverse=True)
+        onehot = np.zeros((num_nodes, len(uniq)), np.float32)
+        onehot[np.arange(num_nodes), inv] = 1.0
+        deg = np.bincount(edge_index[0], minlength=num_nodes).astype(np.float32)
+        features = np.concatenate([onehot, np.log1p(deg)[:, None]], axis=1)
+        labels = np.asarray(labels, dtype=np.float32)  # [V, 112] multi-label
+    else:
+        features = np.asarray(graph["node_feat"], dtype=np.float32)
+        labels = np.asarray(labels).squeeze()
+        # papers100M labels are float with NaN on unlabeled nodes (reference
+        # handles the same in its loaders); class 0 + loss mask is equivalent
+        if np.issubdtype(labels.dtype, np.floating):
+            labels = np.where(np.isnan(labels), 0, labels)
+        labels = labels.astype(np.int32)
     out = {
-        "edge_index": np.asarray(graph["edge_index"], dtype=np.int64),
-        "features": np.asarray(graph["node_feat"], dtype=np.float32),
-        "labels": labels.astype(np.int32),
+        "edge_index": edge_index,
+        "features": features,
+        "labels": labels,
         "num_nodes": num_nodes,
     }
     out.update(
@@ -129,15 +145,19 @@ def lead_first(path: str, build, is_lead: bool, poll_s: float = 5.0,
     the artifact itself (plus a ``.done`` sentinel) is the barrier.
     """
     done = path + ".done"
-    if os.path.exists(done):
+    # the sentinel vouches for the artifact only if the artifact is there too
+    # (a deleted/partial cache with a leftover sentinel must rebuild)
+    if os.path.exists(done) and os.path.exists(path):
         return path
     if is_lead:
+        if os.path.exists(done):
+            os.remove(done)  # stale sentinel without artifact
         build(path)
         with open(done, "w") as f:
             json.dump({"ts": time.time()}, f)
         return path
     waited = 0.0
-    while not os.path.exists(done):
+    while not (os.path.exists(done) and os.path.exists(path)):
         time.sleep(poll_s)
         waited += poll_s
         if waited > timeout_s:
@@ -167,10 +187,16 @@ class DistributedOGBDataset:
         symmetrize: bool = True,
         add_symmetric_norm: bool = True,
         pad_multiple: int = 128,
-        is_lead: bool = True,
+        is_lead: Optional[bool] = None,
     ):
         from dgraph_tpu.data.graph import DistributedGraph
 
+        if is_lead is None:
+            # multi-controller default: exactly one builder (the reference
+            # serializes via rank 0 + barrier, ogbn_datasets.py:67-85)
+            from dgraph_tpu.utils.logging import is_lead_process
+
+            is_lead = is_lead_process()
         self.name = name
         self.world_size = world_size
         os.makedirs(cache_dir, exist_ok=True)
@@ -209,8 +235,9 @@ class DistributedOGBDataset:
                 add_symmetric_norm=add_symmetric_norm,
                 pad_multiple=pad_multiple,
             )
-            with open(path, "wb") as f:
-                pickle.dump(g, f)
+            from dgraph_tpu.train.checkpoint import atomic_pickle_dump
+
+            atomic_pickle_dump(path, g)
 
         lead_first(cache, build, is_lead)
         with open(cache, "rb") as f:

@@ -47,11 +47,14 @@ is needed (the reference needs an explicit recv-placement scatter,
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Optional
 
 import numpy as np
 
 import jax
+
+_logger = logging.getLogger("dgraph_tpu.plan")
 
 # ---------------------------------------------------------------------------
 # pytree dataclass helper
@@ -371,6 +374,55 @@ def plan_memory_usage(plan: EdgePlan, feature_dim: int, dtype_bytes: int = 4) ->
     }
 
 
+def pick_halo_impl(world_size: int, halo_deltas: tuple) -> str:
+    """Choose the halo-exchange lowering from the plan's active peer set.
+
+    Cost model: one padded ``all_to_all`` moves ``(W-1) * s_pad`` remote rows
+    per shard no matter how many peer pairs are actually live; ``ppermute``
+    neighbor rounds move ``len(deltas) * s_pad`` rows but pay one collective
+    launch per round. Rounds win when the peer set is sparse (locality
+    partitions on mesh-like graphs — SURVEY §7 "ppermute rounds only to
+    actual neighbors"); the crossover is ~W/2 live deltas.
+    Returns 'none' | 'ppermute' | 'all_to_all'.
+    """
+    if not halo_deltas:
+        return "none"
+    return "ppermute" if len(halo_deltas) <= max(1, world_size // 2) else "all_to_all"
+
+
+def plan_efficiency(plan: EdgePlan, layout: EdgePlanLayout) -> dict:
+    """Real/padded fill ratios — the padded design's skew telemetry.
+
+    Every per-peer segment pads to the global max, so one hub vertex on a
+    power-law graph can inflate ``s_pad`` for all W² peer pairs; these ratios
+    are the number that decides whether that happened (and which halo
+    lowering to use). The reference reports plan bytes before training
+    (``Trainer.py:113-123``); this is the utilization companion.
+    """
+    W, S, E = plan.world_size, plan.halo.s_pad, plan.e_pad
+    real_edges = int(np.asarray(plan.num_edges).sum())
+    real_halo = int(layout.halo_counts.sum())
+    active_pairs = int((layout.halo_counts > 0).sum())
+    n_deltas = len(plan.halo_deltas)
+    src_total = int(layout.src_counts.sum())
+    dst_total = int(layout.dst_counts.sum())
+    return {
+        "edge_fill": real_edges / max(W * E, 1),
+        "src_vertex_fill": src_total / max(W * plan.n_src_pad, 1),
+        "dst_vertex_fill": dst_total / max(W * plan.n_dst_pad, 1),
+        # fill of the peer segments that actually carry traffic
+        "halo_fill_active": real_halo / max(active_pairs * S, 1),
+        # fraction of all_to_all wire bytes that are real rows (a2a moves all
+        # W*(W-1) remote blocks at s_pad each, live or not)
+        "halo_wire_fill_all_to_all": real_halo / max(W * (W - 1) * S, 1),
+        # same for ppermute rounds (only live deltas move)
+        "halo_wire_fill_ppermute": real_halo / max(n_deltas * W * S, 1) if n_deltas else 1.0,
+        "active_peer_pairs": active_pairs,
+        "num_halo_deltas": n_deltas,
+        "halo_impl": pick_halo_impl(W, plan.halo_deltas),
+    }
+
+
 def validate_plan(plan: EdgePlan) -> None:
     """Host-side structural validation (the index-bounds asserts the
     reference scatters through its kernels, ``RankLocalOps.py:183-184``;
@@ -554,10 +606,8 @@ def build_edge_plan(
     # halo slot (on the needer shard) for each unique (needer, vid) pair
     halo_slot = N_halo_pad + sender * S_pad + pos_in_seg
 
-    # map (needer, vid) -> halo_slot for edge remapping
-    # edges on owner rank r referencing remote vid v: slot = lookup (r, v)
-    lookup = {}
-    # vectorized: searchsorted into enc_u
+    # map (needer, vid) -> halo_slot for edge remapping: edges on owner rank
+    # r referencing remote vid v find their slot by searchsorted into enc_u
     edge_enc = owner.astype(np.int64) * v_total + halo_vid
     idx_in_u = np.searchsorted(enc_u, edge_enc)
     # guard for purely-local edges (no match needed)
@@ -631,11 +681,7 @@ def build_edge_plan(
         scatter_mc=scatter_mc,
         scatter_block_e=scatter_block_e,
         scatter_block_n=scatter_block_n,
-        halo_deltas=tuple(
-            int(d)
-            for d in np.unique((needer - sender) % W)
-            if halo_counts.sum() > 0
-        ),
+        halo_deltas=tuple(int(d) for d in np.unique((needer - sender) % W)),
     )
     layout = EdgePlanLayout(
         edge_rank=edge_rank,
@@ -643,6 +689,14 @@ def build_edge_plan(
         halo_counts=halo_counts,
         src_counts=src_counts,
         dst_counts=dst_counts,
+    )
+    eff = plan_efficiency(plan, layout)
+    _logger.info(
+        "EdgePlan built: W=%d E=%d e_pad=%d (fill %.3f) s_pad=%d "
+        "halo_fill_active=%.3f wire_fill[a2a=%.3f pp=%.3f] deltas=%d -> %s",
+        W, E, E_pad, eff["edge_fill"], S_pad,
+        eff["halo_fill_active"], eff["halo_wire_fill_all_to_all"],
+        eff["halo_wire_fill_ppermute"], eff["num_halo_deltas"], eff["halo_impl"],
     )
     return plan, layout
 

@@ -23,6 +23,16 @@ from typing import Any, Optional
 import numpy as np
 
 
+def atomic_pickle_dump(path: str, obj: Any) -> None:
+    """Pickle to a temp file, then os.replace into place: concurrent readers
+    (multi-process launches polling a cache path) never see a truncated
+    artifact."""
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(obj, f)
+    os.replace(tmp, path)
+
+
 # --- train state checkpointing (orbax) ---
 
 
@@ -62,8 +72,15 @@ def restore_checkpoint(ckpt_dir: str, template: dict, step: Optional[int] = None
 # --- plan cache ---
 
 
+# Bump whenever EdgePlan's fields/defaults change shape or meaning: stale
+# cache pickles must REBUILD, not silently inherit new class defaults for
+# fields they were never built with (e.g. scatter_block_e).
+PLAN_FORMAT_VERSION = 2
+
+
 def _graph_fingerprint(edge_index: np.ndarray, partition: np.ndarray, **kw) -> str:
     h = hashlib.sha256()
+    h.update(f"plan-format-v{PLAN_FORMAT_VERSION};".encode())
     h.update(np.ascontiguousarray(edge_index).tobytes())
     h.update(np.ascontiguousarray(partition).tobytes())
     h.update(repr(sorted(kw.items())).encode())
@@ -95,6 +112,5 @@ def cached_edge_plan(
         with open(path, "rb") as f:
             return pickle.load(f)
     result = build_edge_plan(edge_index, src_partition, dst_partition, **build_kwargs)
-    with open(path, "wb") as f:
-        pickle.dump(result, f)
+    atomic_pickle_dump(path, result)
     return result

@@ -46,6 +46,13 @@ class Config:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
 
 
+def _num_classes(labels: np.ndarray) -> int:
+    # multi-label float targets ([V, C], e.g. ogbn-proteins): C is the width
+    if labels.ndim > 1:
+        return int(labels.shape[1])
+    return int(labels.max()) + 1
+
+
 def load_data(cfg: DataConfig):
     if cfg.ogb_name:
         from dgraph_tpu.data import ogbn
@@ -54,16 +61,17 @@ def load_data(cfg: DataConfig):
             ogbn.from_npz(cfg.path) if cfg.path
             else ogbn.load_ogb_arrays(cfg.ogb_name)
         )
+        labels = np.asarray(arrs["labels"])
         return {
             "edge_index": np.asarray(arrs["edge_index"]),
             "features": np.asarray(arrs["features"]),
-            "labels": np.asarray(arrs["labels"]),
+            "labels": labels,
             "masks": {
                 k.removesuffix("_mask"): np.asarray(v)
                 for k, v in arrs.items()
                 if k.endswith("_mask")
             },
-            "num_classes": int(np.asarray(arrs["labels"]).max()) + 1,
+            "num_classes": _num_classes(labels),
         }
     if cfg.path:
         z = np.load(cfg.path)
@@ -75,7 +83,7 @@ def load_data(cfg: DataConfig):
             "features": z["features"],
             "labels": z["labels"],
             "masks": masks,
-            "num_classes": int(z["labels"].max()) + 1,
+            "num_classes": _num_classes(np.asarray(z["labels"])),
         }
     from dgraph_tpu.data import synthetic
 
@@ -132,8 +140,13 @@ def main(cfg: Config):
     params = init_params(model, mesh, plan, batch_tr)
     optimizer = optax.adam(cfg.lr)
     opt_state = optimizer.init(params)
-    train_step = make_train_step(model, optimizer, mesh, plan)
-    eval_step = make_eval_step(model, mesh)
+    from dgraph_tpu.train.loop import masked_bce_multilabel, masked_cross_entropy
+
+    loss_fn = (
+        masked_bce_multilabel if np.asarray(g.labels).ndim > 2 else masked_cross_entropy
+    )
+    train_step = make_train_step(model, optimizer, mesh, plan, loss_fn=loss_fn)
+    eval_step = make_eval_step(model, mesh, loss_fn=loss_fn)
     log = ExperimentLog(cfg.log_path)
 
     epoch_times = []

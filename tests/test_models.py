@@ -194,3 +194,33 @@ def test_distributed_gradients_match_single_device(mesh8, sbm):
         dist_grads,
         dense_grads,
     )
+
+
+def test_multilabel_float_targets_train(mesh8, sbm):
+    """ogbn-proteins-shaped path: float [V, C] multi-label targets survive
+    DistributedGraph.from_global (no int cast) and train under the BCE loss
+    (the reference handles proteins via a per-dataset num_classes table,
+    ``ogbn_datasets.py:25-37``)."""
+    from dgraph_tpu.train.loop import fit, masked_bce_multilabel
+
+    rng = np.random.default_rng(3)
+    C = 6
+    multilabels = (rng.random((400, C)) < 0.3).astype(np.float32)
+    g8 = DistributedGraph.from_global(
+        sbm["edge_index"],
+        sbm["features"],
+        multilabels,
+        sbm["masks"],
+        world_size=8,
+        partition_method="random",
+        add_symmetric_norm=True,
+    )
+    assert g8.labels.dtype == np.float32 and g8.labels.shape[-1] == C
+    comm8 = Communicator.init_process_group("tpu", world_size=8)
+    model = GCN(hidden_features=16, out_features=C, comm=comm8)
+    params, history = fit(
+        model, g8, mesh8, optimizer=optax.adam(5e-3), num_epochs=15,
+        loss_fn=masked_bce_multilabel,
+    )
+    assert history[-1]["loss"] < history[0]["loss"]
+    assert np.isfinite(history[-1]["loss"])
