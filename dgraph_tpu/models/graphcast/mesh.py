@@ -27,26 +27,47 @@ class MultiMesh:
 
 
 def icosahedron() -> tuple[np.ndarray, np.ndarray]:
-    """Unit icosahedron: 12 vertices, 20 faces."""
+    """Unit icosahedron: 12 vertices, 20 faces — in the GraphCast paper's
+    orientation.
+
+    The vertex set is the standard cyclic-permutation construction
+    (Wikipedia "Regular icosahedron" Cartesian coordinates), rotated about
+    the y-axis by (pi - angle_between_faces)/2 so a face plane (not an
+    edge) is horizontal at the top. The orientation matters: the grid2mesh
+    radius-graph edge COUNT depends on where mesh vertices sit relative to
+    the lat-lon grid, and the paper's 1 618 824 anchor is only reproduced
+    in this orientation (reference vendored generator,
+    ``data_utils/icosahedral_mesh.py:100-181``).
+
+    Faces are derived from the convex hull (outward-oriented) rather than a
+    hand-checked table; only vertex POSITIONS affect downstream edge
+    counts (midpoint vertices are position-determined).
+    """
+    from scipy.spatial import ConvexHull
+
     phi = (1.0 + np.sqrt(5.0)) / 2.0
-    verts = np.array(
-        [
-            [-1, phi, 0], [1, phi, 0], [-1, -phi, 0], [1, -phi, 0],
-            [0, -1, phi], [0, 1, phi], [0, -1, -phi], [0, 1, -phi],
-            [phi, 0, -1], [phi, 0, 1], [-phi, 0, -1], [-phi, 0, 1],
-        ],
-        dtype=np.float64,
+    verts = []
+    for c1 in (1.0, -1.0):
+        for c2 in (phi, -phi):
+            verts.extend([(c1, c2, 0.0), (0.0, c1, c2), (c2, 0.0, c1)])
+    verts = np.asarray(verts, dtype=np.float64)
+    verts /= np.linalg.norm([1.0, phi])
+    # rotate about y: top becomes a face plane (angle between adjacent
+    # faces of an icosahedron = 2*arcsin(phi/sqrt(3)))
+    angle = (np.pi - 2.0 * np.arcsin(phi / np.sqrt(3.0))) / 2.0
+    c, s = np.cos(angle), np.sin(angle)
+    rot_y = np.array([[c, 0.0, s], [0.0, 1.0, 0.0], [-s, 0.0, c]])
+    verts = verts @ rot_y
+    hull = ConvexHull(verts)
+    faces = hull.simplices.astype(np.int64)
+    # orient each face counter-clockwise seen from outside
+    n = np.cross(
+        verts[faces[:, 1]] - verts[faces[:, 0]],
+        verts[faces[:, 2]] - verts[faces[:, 0]],
     )
-    verts /= np.linalg.norm(verts, axis=1, keepdims=True)
-    faces = np.array(
-        [
-            [0, 11, 5], [0, 5, 1], [0, 1, 7], [0, 7, 10], [0, 10, 11],
-            [1, 5, 9], [5, 11, 4], [11, 10, 2], [10, 7, 6], [7, 1, 8],
-            [3, 9, 4], [3, 4, 2], [3, 2, 6], [3, 6, 8], [3, 8, 9],
-            [4, 9, 5], [2, 4, 11], [6, 2, 10], [8, 6, 7], [9, 8, 1],
-        ],
-        dtype=np.int64,
-    )
+    centers = verts[faces].mean(axis=1)
+    flip = (n * centers).sum(axis=1) < 0
+    faces[flip] = faces[flip][:, ::-1]
     return verts, faces
 
 
@@ -126,22 +147,43 @@ def latlon_to_xyz(latlon: np.ndarray) -> np.ndarray:
 
 
 def grid2mesh_edges(
-    grid_xyz: np.ndarray, mesh: MultiMesh, radius_fraction: float = 0.6
+    grid_xyz: np.ndarray,
+    mesh: MultiMesh,
+    radius_fraction: float = 0.6,
+    max_neighbors: int = 4,
 ) -> np.ndarray:
-    """Connect each grid point to all mesh vertices within
-    ``radius_fraction * max_mesh_edge_length`` (the reference's 0.6 x max-edge
-    radius graph, ``data_utils/utils.py:148-187``). Returns [2, E] with
-    src=grid index, dst=mesh vertex index.
+    """Connect each grid point to its <=``max_neighbors`` nearest mesh
+    vertices that lie strictly within
+    ``radius_fraction * max_FINEST_mesh_edge_length``.
+
+    Exact behavior parity with the reference
+    (``data_utils/utils.py:143-187``: 4-NN query, strict ``<`` radius test)
+    including two subtleties that change the edge count:
+    - the radius is measured on the FINEST-level mesh
+      (``graphcast_graph.py:299-301`` / ``spatial_utils.py:21-44``), not the
+      multimesh — the multimesh contains level-0 icosahedron edges whose
+      ~1.05 chord length would inflate the radius ~6x and the edge count ~40x;
+    - neighbors are capped at 4 per grid point, so the count at level 6 /
+      721x1440 is exactly 1 618 824 (the reference's anchor,
+      ``tests/test_single_graph_data.py:27-29``), not the ~1.63M an
+      uncapped radius query yields.
+
+    Vectorized as one batched k-NN query instead of ``query_ball_point``'s
+    per-point Python lists (VERDICT r1 flagged the list-of-lists path at 1M+
+    grid points). Returns [2, E] with src=grid index, dst=mesh vertex index.
     """
     from scipy.spatial import cKDTree
 
-    edge_vec = mesh.vertices[mesh.edges[0]] - mesh.vertices[mesh.edges[1]]
-    max_len = np.linalg.norm(edge_vec, axis=1).max()
-    radius = radius_fraction * max_len
+    finest = faces_to_edges(mesh.faces)
+    edge_vec = mesh.vertices[finest[0]] - mesh.vertices[finest[1]]
+    radius = radius_fraction * np.linalg.norm(edge_vec, axis=1).max()
     tree = cKDTree(mesh.vertices)
-    nbrs = tree.query_ball_point(grid_xyz, r=radius)
-    src = np.repeat(np.arange(len(grid_xyz)), [len(n) for n in nbrs])
-    dst = np.concatenate([np.asarray(n, dtype=np.int64) for n in nbrs])
+    dist, idx = tree.query(grid_xyz, k=max_neighbors, workers=-1)
+    in_range = dist < radius  # strict <, reference utils.py:157
+    src = np.broadcast_to(
+        np.arange(len(grid_xyz), dtype=np.int64)[:, None], idx.shape
+    )[in_range]
+    dst = idx[in_range]
     return np.stack([src, dst]).astype(np.int64)
 
 

@@ -42,6 +42,27 @@ class TestMultimesh:
         fwd = set(map(tuple, mm.edges.T.tolist()))
         assert all((b, a) in fwd for a, b in fwd)
 
+    def test_level6_paper_anchors(self):
+        """Reference-scale correctness anchors (level-6 mesh, 721x1440 ERA5
+        grid) — the exact constants the reference pins from the paper
+        (``experiments/GraphCast/tests/test_single_graph_data.py:20-34``):
+        40 962 mesh nodes, 1 618 824 grid2mesh edges, 3 114 720 mesh2grid
+        edges. The reference asserts 655 320 mesh edges because its
+        face-derived edge list double-counts every directed edge (each
+        undirected edge belongs to two faces and its builder
+        bidirectionalizes without dedup, ``icosahedral_mesh.py:298-300``);
+        our multimesh stores each directed edge once — 327 660, the paper's
+        M6 count — so the parity relation is 2x."""
+        mm = build_multimesh(6)
+        assert mm.vertices.shape[0] == 40_962
+        assert mm.edges.shape[1] == 327_660
+        assert 2 * mm.edges.shape[1] == 655_320  # reference convention
+        _, xyz = mesh_lib.latlon_grid(721, 1440)
+        g2m = mesh_lib.grid2mesh_edges(xyz, mm)
+        assert g2m.shape[1] == 1_618_824
+        m2g = mesh_lib.mesh2grid_edges(xyz, mm)
+        assert m2g.shape[1] == 3_114_720
+
 
 class TestGridMeshEdges:
     def test_mesh2grid_three_per_point(self):
