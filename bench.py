@@ -1,19 +1,40 @@
-"""Benchmark harness: full-graph GCN training epoch time at ogbn-arxiv scale.
+"""Benchmark harness. Prints ONE JSON line to stdout with the primary
+metric (arxiv-scale GCN epoch time) plus roofline context and a GraphCast
+reference-scale step time:
 
-Prints ONE JSON line to stdout:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-(stage progress goes to stderr).
+  {"metric": "arxiv_gcn_epoch_time", "value": N, "unit": "ms",
+   "vs_baseline": R, "mfu_pct": ..., "hbm_pct": ..., "model_tflops_s": ...,
+   "graphcast_step_ms": ..., "config": {...}}
 
-The reference publishes no numbers (BASELINE.md), so vs_baseline compares
-against OUR recorded number in BENCH_BASELINE.json when present (ratio > 1.0
-= faster than recorded). The measured quantity mirrors the reference's OGB
-harness (per-epoch training time, avg excluding the first/compile epoch —
-``experiments/OGB/main.py:129-221``) on an arxiv-shaped synthetic graph
-(169 343 vertices / 2.33M directed edges / 128 features / 40 classes).
+Stage progress goes to stderr. vs_baseline compares against OUR recorded
+round-1 number in BENCH_BASELINE.json (the reference publishes no numbers,
+BASELINE.md); ratio > 1.0 = faster than that recording.
 
-Device-transfer budget is kept minimal for the tunneled single-chip setup:
-features/labels are generated ON device; only the int32 plan crosses the
-wire (~30 MB).
+Measured quantities mirror the reference's harnesses:
+- per-epoch full-graph GCN training time, avg excluding compile
+  (``experiments/OGB/main.py:129-221``) on an arxiv-shaped synthetic graph
+  (169 343 vertices / 2.33M directed edges / 128 features / 40 classes);
+- GraphCast training step time (``microbenchmark_graphcast.py:63-247``) at
+  the paper's level-6 mesh / 721x1440 ERA5 grid scale.
+
+Roofline context (VERDICT r1 #1): model_tflops_s counts the DENSE matmul
+FLOPs only (gather/scatter one-hot work is overhead, not model math);
+mfu_pct is vs the v5e bf16 peak (197 TFLOP/s), hbm_pct is the achieved
+fraction of HBM peak (819 GB/s) for the analytic minimum edge/vertex
+stream traffic. Between them they say how far the epoch is from the
+hardware ceiling no matter which resource binds.
+
+Timing protocol for the tunneled chip: ``block_until_ready`` is NOT a
+reliable completion barrier and repeated same-input dispatches can be
+memoized, so run n epochs INSIDE one jit (lax.scan), force completion with
+a scalar fetch, and report the delta between two scan lengths — per-call
+RPC latency cancels out. If a rep round yields no positive delta (tunnel
+noise), the round is retried; persistent failure reports NaN and exits
+nonzero rather than a nonsense number (ADVICE r1 #3).
+
+Env knobs: DGRAPH_BENCH_DTYPE (bfloat16|float32, default bfloat16),
+DGRAPH_TPU_PALLAS_SCATTER (default on here), DGRAPH_BENCH_GRAPHCAST=0 to
+skip stage 2, DGRAPH_BENCH_GC_LATENT / _GC_LEVEL to resize it.
 """
 
 from __future__ import annotations
@@ -23,28 +44,94 @@ import os
 import sys
 import time
 
+V5E_PEAK_TFLOPS = 197.0  # bf16
+V5E_PEAK_HBM_GBPS = 819.0
+
 
 def log(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
-def main():
-    import numpy as np
+def _timed_scan_ms(epochs_fn, state, n_long, reps=3, max_rounds=6):
+    """Median positive (long-short)/(n_long-1) delta in ms; retries noisy
+    rounds, returns (ms, state) or (nan, state) if the tunnel never yields a
+    positive delta."""
+    deltas = []
+    rounds = 0
+    while len(deltas) < reps and rounds < max_rounds:
+        rounds += 1
+        t0 = time.perf_counter()
+        state = epochs_fn(state, 1)
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        state = epochs_fn(state, n_long)
+        t_long = time.perf_counter() - t0
+        d = (t_long - t1) / (n_long - 1) * 1000.0
+        log(f"  round {rounds}: 1-iter {t1*1000:.1f} ms, {n_long}-iter "
+            f"{t_long*1000:.1f} ms -> {d:.2f} ms/iter")
+        if d > 0:
+            deltas.append(d)
+    if not deltas:
+        return float("nan"), state
+    return sorted(deltas)[len(deltas) // 2], state
 
-    t_start = time.time()
-    log("importing jax...")
+
+def pallas_selfcheck() -> bool:
+    """Chip-gated Pallas correctness check (VERDICT r1 weak #3): the Mosaic
+    lowering class of bug is invisible to the interpret-mode CI tests, so
+    verify the real kernel against numpy right before using it."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return False
+    from dgraph_tpu.ops.pallas_segment import max_chunks_hint, sorted_segment_sum
+
+    rng = np.random.default_rng(7)
+    E, N, F = 8192, 2048, 128
+    ids = np.sort(rng.integers(0, N, E)).astype(np.int32)
+    data = rng.standard_normal((E, F)).astype(np.float32)
+    want = np.zeros((N, F), np.float32)
+    np.add.at(want, ids, data)
+    ok = True
+    # check the exact tile configs the plans emit (a Mosaic bug can be
+    # tile-size-dependent), plus the library default
+    from dgraph_tpu.plan import SCATTER_BLOCK_E, SCATTER_BLOCK_N
+
+    configs = {(512, 256), (SCATTER_BLOCK_E, SCATTER_BLOCK_N)}
+    for be, bn in sorted(configs):
+        try:
+            got = np.asarray(
+                sorted_segment_sum(
+                    jnp.asarray(data), jnp.asarray(ids), N,
+                    max_chunks_per_block=max_chunks_hint(ids, N, block_e=be, block_n=bn),
+                    block_e=be, block_n=bn,
+                )
+            )
+            this_ok = bool(np.allclose(got, want, rtol=1e-4, atol=1e-4))
+        except Exception as e:  # Mosaic compile failure = exactly what we gate on
+            log(f"pallas self-check (be={be},bn={bn}) raised {type(e).__name__}: {e}")
+            this_ok = False
+        log(f"pallas self-check on chip (be={be},bn={bn}): {'OK' if this_ok else 'FAILED'}")
+        ok = ok and this_ok
+    return ok
+
+
+def bench_gcn(dtype_name: str):
+    import functools
+
+    import numpy as np
     import jax
     import jax.numpy as jnp
     import optax
-
-    log(f"devices: {jax.devices()}")
 
     from dgraph_tpu.comm import Communicator
     from dgraph_tpu.models import GCN
     from dgraph_tpu.plan import build_edge_plan
 
     # ogbn-arxiv shape (V=169343, E~1.17M directed, symmetrized ~2.33M)
-    V, E_half, F, C = 169_343, 1_166_243, 128, 40
+    V, E_half, F, C, H = 169_343, 1_166_243, 128, 40, 256
     rng = np.random.default_rng(0)
     src = rng.integers(0, V, E_half)
     dst = rng.integers(0, V, E_half)
@@ -53,19 +140,19 @@ def main():
     ).astype(np.int64)
 
     log("building plan (host)...")
-    part = np.zeros(V, np.int32)  # single-chip bench: world size 1
-    plan_np, layout = build_edge_plan(
+    part = np.zeros(V, np.int32)  # single-chip: world size 1
+    plan_np, _ = build_edge_plan(
         edge_index, part, world_size=1, edge_owner="dst", pad_multiple=128
     )
     log("moving plan to device...")
     plan = jax.tree.map(lambda leaf: jnp.asarray(np.asarray(leaf)[0]), plan_np)
     jax.block_until_ready(jax.tree.leaves(plan))
 
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
     comm = Communicator.init_process_group("single")
-    model = GCN(hidden_features=256, out_features=C, comm=comm, num_layers=2)
+    model = GCN(hidden_features=H, out_features=C, comm=comm, num_layers=2, dtype=dtype)
 
     log("generating data on device...")
-    n_pad = plan.src_index.shape  # noqa: F841 (forces plan realized)
     x = jax.random.normal(jax.random.key(0), (plan_np.n_src_pad, F), jnp.float32)
     y = jax.random.randint(jax.random.key(1), (plan_np.n_src_pad,), 0, C)
     mask = (jnp.arange(plan_np.n_src_pad) < V).astype(jnp.float32)
@@ -76,13 +163,6 @@ def main():
     optimizer = optax.adam(1e-3)
     opt_state = optimizer.init(params)
 
-    import functools
-
-    # Timing protocol for the tunneled chip: `block_until_ready` is NOT a
-    # reliable completion barrier there and repeated same-input dispatches
-    # can be memoized, so run n epochs INSIDE one jit (lax.scan), force
-    # completion with a scalar fetch, and report the delta between two scan
-    # lengths — per-call RPC latency cancels out.
     @functools.partial(jax.jit, static_argnames="n", donate_argnums=(0, 1))
     def epochs(params, opt_state, salt, n):
         def lf(p):
@@ -98,55 +178,197 @@ def main():
             p = optax.apply_updates(p, updates)
             return (p, o, s + loss * 1e-20), None
 
-        (p, o, s), _ = jax.lax.scan(
-            body, (params, opt_state, salt), None, length=n
-        )
+        (p, o, s), _ = jax.lax.scan(body, (params, opt_state, salt), None, length=n)
         return p, o, s
 
     N_LONG = 6
-    log("compiling (n=1 and n=%d)..." % N_LONG)
-    params, opt_state, s = epochs(params, opt_state, jnp.float32(0.0), 1)
-    float(s)
-    params, opt_state, s = epochs(params, opt_state, s, N_LONG)
-    float(s)
-    log(f"warmup done ({time.time() - t_start:.1f}s since start); timing...")
+    log(f"compiling (n=1 and n={N_LONG})...")
+    state = (params, opt_state, jnp.float32(0.0))
 
-    deltas = []
-    for rep in range(3):
-        t0 = time.perf_counter()
-        params, opt_state, s = epochs(params, opt_state, s, 1)
+    def run(state, n):
+        p, o, s = epochs(*state, n)
         float(s)  # scalar fetch = the only trustworthy completion barrier
-        t1 = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        params, opt_state, s = epochs(params, opt_state, s, N_LONG)
+        return (p, o, s)
+
+    state = run(state, 1)
+    state = run(state, N_LONG)
+    log("warmup done; timing...")
+    dt_ms, state = _timed_scan_ms(run, state, N_LONG)
+
+    # --- roofline context ---
+    Vp, Ep = plan_np.n_src_pad, plan_np.e_pad
+    b = 2 if dtype_name == "bfloat16" else 4
+    # dense model FLOPs: fwd projections (2 per conv layer) + head; x3 for
+    # fwd+bwd (dgrad+wgrad)
+    dense_fwd = 2 * Vp * F * H * 2 + 2 * Vp * H * H * 2 + 2 * Vp * H * C
+    model_flops = 3 * dense_fwd
+    # analytic minimum HBM stream traffic per epoch (each E-row tensor
+    # counted once per producing/consuming op):
+    #   fwd/layer: 2 gathers (write E.H + read V.H each) + 1 scatter
+    #     (read E.H, write V.H)
+    #   bwd/layer: 1 take (write E.H, read V.H) + 2 segment sums
+    #     (read E.H, write V.H each)
+    per_layer = 6 * (Ep * H + Vp * H) * b
+    hbm_bytes = 2 * per_layer + 3 * (Vp * (F + H) * b)  # + input/proj streams
+    if dt_ms != dt_ms:  # NaN timing: no roofline numbers (keep JSON valid)
+        return dt_ms, {}
+    secs = dt_ms / 1e3
+    tflops_s = model_flops / secs / 1e12
+    gbps = hbm_bytes / secs / 1e9
+    return dt_ms, {
+        "model_tflops_s": round(tflops_s, 2),
+        "mfu_pct": round(100 * tflops_s / V5E_PEAK_TFLOPS, 2),
+        "hbm_gbps_min": round(gbps, 1),
+        "hbm_pct": round(100 * gbps / V5E_PEAK_HBM_GBPS, 1),
+    }
+
+
+def bench_graphcast(dtype_name: str):
+    """GraphCast train-step time at reference scale (level-6 mesh,
+    721x1440 grid) on one chip. Plans come from the host; all feature data
+    is generated on device (tunnel budget)."""
+    import functools
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dgraph_tpu.comm import Communicator
+    from dgraph_tpu.models.graphcast import GraphCast, build_graphcast_graphs
+
+    level = int(os.environ.get("DGRAPH_BENCH_GC_LEVEL", "6"))
+    latent = int(os.environ.get("DGRAPH_BENCH_GC_LATENT", "256"))
+    layers = int(os.environ.get("DGRAPH_BENCH_GC_LAYERS", "16"))
+    nlat, nlon, ch = 721, 1440, 73
+    log(f"graphcast: building level-{level} graphs on host...")
+    t0 = time.time()
+    graphs = build_graphcast_graphs(level, nlat, nlon, 1)
+    log(f"graphcast: graphs built in {time.time()-t0:.1f}s "
+        f"(g2m={graphs.g2m_plan.e_pad} m2g={graphs.m2g_plan.e_pad} "
+        f"mesh={graphs.mesh_plan.e_pad})")
+
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    comm = Communicator.init_process_group("single")
+    model = GraphCast(
+        comm=comm, latent=latent, processor_layers=layers, out_channels=ch,
+        dtype=dtype,
+    )
+
+    def dev(a):
+        return jnp.asarray(np.asarray(a)[0])
+
+    statics = {
+        "grid_node_static": dev(graphs.grid_node_static),
+        "mesh_node_static": dev(graphs.mesh_node_static),
+        "mesh_edge_static": dev(graphs.mesh_edge_static),
+        "g2m_edge_static": dev(graphs.g2m_edge_static),
+        "m2g_edge_static": dev(graphs.m2g_edge_static),
+    }
+    plans = {
+        "mesh": jax.tree.map(dev, graphs.mesh_plan),
+        "g2m": jax.tree.map(dev, graphs.g2m_plan),
+        "m2g": jax.tree.map(dev, graphs.m2g_plan),
+    }
+    jax.block_until_ready(jax.tree.leaves((statics, plans)))
+    log("graphcast: statics+plans on device")
+
+    n_grid = plans["g2m"].n_src_pad
+    x = jax.random.normal(jax.random.key(3), (n_grid, ch), jnp.float32)
+    y = jax.random.normal(jax.random.key(4), (n_grid, ch), jnp.float32)
+    gmask = dev(graphs.grid_mask)
+
+    params = model.init(jax.random.key(5), x, statics, plans)
+    opt = optax.adamw(1e-4, weight_decay=0.1)
+    opt_state = opt.init(params)
+    log("graphcast: params initialized; compiling step scan...")
+
+    @functools.partial(jax.jit, static_argnames="n", donate_argnums=(0, 1))
+    def steps(params, opt_state, salt, n):
+        def lf(p):
+            pred = model.apply(p, x, statics, plans)
+            se = ((pred - y) ** 2).sum(-1) * gmask
+            return se.sum() / jnp.maximum(gmask.sum(), 1.0)
+
+        def body(carry, _):
+            p, o, s = carry
+            loss, grads = jax.value_and_grad(lf)(p)
+            updates, o = opt.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return (p, o, s + loss * 1e-20), None
+
+        (p, o, s), _ = jax.lax.scan(body, (params, opt_state, salt), None, length=n)
+        return p, o, s
+
+    def run(state, n):
+        p, o, s = steps(*state, n)
         float(s)
-        t_long = time.perf_counter() - t0
-        deltas.append((t_long - t1) / (N_LONG - 1) * 1000.0)
-        log(f"rep {rep}: 1-epoch {t1*1000:.1f} ms, {N_LONG}-epoch {t_long*1000:.1f} ms -> {deltas[-1]:.2f} ms/epoch")
-    positive = [d for d in deltas if d > 0]
-    dt_ms = sorted(positive)[len(positive) // 2] if positive else sorted(deltas)[-1]
-    log(f"epoch time {dt_ms:.2f} ms")
+        return (p, o, s)
+
+    state = (params, opt_state, jnp.float32(0.0))
+    state = run(state, 1)
+    state = run(state, 4)
+    log("graphcast: warmup done; timing...")
+    ms, _ = _timed_scan_ms(run, state, 4)
+    return ms, {"level": level, "latent": latent, "layers": layers}
+
+
+def main():
+    t_start = time.time()
+    log("importing jax...")
+    import jax
+
+    log(f"devices: {jax.devices()}")
+
+    from dgraph_tpu import config as cfg
+
+    dtype_name = os.environ.get("DGRAPH_BENCH_DTYPE", "bfloat16")
+    # Pallas scatter: default ON for the bench (A/B'd on chip; see
+    # logs/kernels_r2.jsonl + VERDICT r1 next-round #2), unless the chip
+    # self-check fails or the env explicitly disables it.
+    want_pallas = os.environ.get("DGRAPH_TPU_PALLAS_SCATTER", "1") != "0"
+    cfg.set_flags(use_pallas_scatter=want_pallas and pallas_selfcheck())
+
+    dt_ms, roof = bench_gcn(dtype_name)
+    log(f"gcn epoch time {dt_ms:.2f} ms {roof}")
+
+    gc_ms, gc_info = float("nan"), {}
+    if os.environ.get("DGRAPH_BENCH_GRAPHCAST", "1") != "0":
+        try:
+            gc_ms, gc_info = bench_graphcast(dtype_name)
+            log(f"graphcast step time {gc_ms:.2f} ms {gc_info}")
+        except Exception as e:  # stage-2 failure must not kill the metric
+            log(f"graphcast stage failed: {type(e).__name__}: {e}")
 
     vs = 1.0
-    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
-    if os.path.exists(base_path):
+    base_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json"
+    )
+    if os.path.exists(base_path) and dt_ms == dt_ms:
         try:
             base = json.load(open(base_path))
             if base.get("unit") == "ms" and base.get("value"):
-                vs = float(base["value"]) / dt_ms  # >1 = faster than baseline
+                vs = float(base["value"]) / dt_ms  # >1 = faster than recorded
         except Exception:
             pass
 
-    print(
-        json.dumps(
-            {
-                "metric": "arxiv_gcn_epoch_time",
-                "value": round(dt_ms, 3),
-                "unit": "ms",
-                "vs_baseline": round(vs, 4),
-            }
-        )
-    )
+    out = {
+        "metric": "arxiv_gcn_epoch_time",
+        "value": round(dt_ms, 3) if dt_ms == dt_ms else None,
+        "unit": "ms",
+        "vs_baseline": round(vs, 4),
+        **roof,
+        "graphcast_step_ms": round(gc_ms, 2) if gc_ms == gc_ms else None,
+        "graphcast_config": gc_info,
+        "config": {
+            "dtype": dtype_name,
+            "pallas_scatter": cfg.use_pallas_scatter,
+        },
+        "wall_s": round(time.time() - t_start, 1),
+    }
+    print(json.dumps(out))
+    if dt_ms != dt_ms:  # NaN: tunnel never produced a positive delta
+        sys.exit(2)
 
 
 if __name__ == "__main__":

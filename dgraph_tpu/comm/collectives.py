@@ -178,9 +178,23 @@ def gather(
     if side == plan.halo_side:
         haloed = halo_exchange(x, plan.halo, axis_name, deltas=plan.halo_deltas)
         full = jnp.concatenate([x, haloed], axis=0)
+        sorted_ids = False  # mixed local/halo-slot numbering
     else:
         full = x
-    return local_ops.row_take(full, idx) * plan.edge_mask[:, None].astype(x.dtype)
+        # owner-side ids are plan-sorted; route the VJP (a scatter-sum
+        # transpose, _torch_func_impl.py:112-191) through the sorted path
+        sorted_ids = plan.owner_sorted
+    from dgraph_tpu import config as _cfg
+
+    hints = (
+        (plan.scatter_block_e, plan.scatter_block_n, plan.scatter_mc)
+        if (sorted_ids and _cfg.use_pallas_scatter)
+        else None
+    )
+    taken = local_ops.take_rows(
+        full, idx, indices_are_sorted=sorted_ids, pallas_hints=hints
+    )
+    return taken * plan.edge_mask[:, None].astype(x.dtype)
 
 
 @_scoped("dgraph.scatter_sum")

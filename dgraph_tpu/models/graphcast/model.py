@@ -22,7 +22,17 @@ from dgraph_tpu.models.mlp import MLP
 
 
 class MeshEdgeBlock(nn.Module):
-    """e' = e + MLP([e, h_src(gathered), h_dst(gathered)]) — layers.py:146-216."""
+    """e' = e + MLP([e, h_src(gathered), h_dst(gathered)]) — layers.py:146-216.
+
+    TPU-first algebra: the first MLP layer is computed as
+    ``act(D_e(e) + gather(D_s(x_src)) + gather(D_d(x_dst)))`` — splitting
+    the [3L -> L] Dense by input rows — instead of materializing the
+    [E, 3L] concat the reference builds (``layers.py:182-216``). Exact same
+    math (the concat-Dense's weight matrix split into three blocks), but
+    the projections run at the VERTEX level (N << E) and the [E, 3L]
+    tensor never exists: at m2g scale (3.11M edges, latent 256, bf16) that
+    single tensor is 4.8 GB and its elimination is what lets level-6 AD
+    fit one v5e chip."""
 
     latent: int
     comm: Any
@@ -30,11 +40,17 @@ class MeshEdgeBlock(nn.Module):
 
     @nn.compact
     def __call__(self, e, x_src, x_dst, plan):
-        h_src = self.comm.gather(x_src, plan, side="src")
-        h_dst = self.comm.gather(x_dst, plan, side="dst")
-        upd = MLP([self.latent, self.latent], use_layer_norm=True, dtype=self.dtype)(
-            jnp.concatenate([e, h_src, h_dst], axis=-1)
+        L = self.latent
+        h_s = self.comm.gather(
+            nn.Dense(L, use_bias=False, name="src_proj", dtype=self.dtype)(x_src),
+            plan, side="src",
         )
+        h_d = self.comm.gather(
+            nn.Dense(L, use_bias=False, name="dst_proj", dtype=self.dtype)(x_dst),
+            plan, side="dst",
+        )
+        h = nn.silu(nn.Dense(L, name="edge_proj", dtype=self.dtype)(e) + h_s + h_d)
+        upd = MLP([self.latent], use_layer_norm=True, dtype=self.dtype)(h)
         return e + upd
 
 
@@ -72,6 +88,10 @@ class GraphCast(nn.Module):
     out_channels: int = 73
     comm: Any = None
     dtype: Any = None  # compute dtype (bfloat16 recommended on TPU)
+    remat: bool = True  # rematerialize processor blocks under AD: per-layer
+    # saved state drops to the two residual streams (e_mesh, m); trades
+    # ~2x processor recompute FLOPs for the memory that lets 16-layer
+    # level-6 training fit one chip (jax.checkpoint, SURVEY §5 memory knobs)
 
     @nn.compact
     def __call__(self, grid_feats, statics, plans):
@@ -99,11 +119,13 @@ class GraphCast(nn.Module):
         g = g + MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="enc_grid_mlp")(g)
 
         # --- Processor: multimesh message passing (model.py:208-230) ---
+        EdgeB = nn.remat(MeshEdgeBlock) if self.remat else MeshEdgeBlock
+        NodeB = nn.remat(MeshNodeBlock) if self.remat else MeshNodeBlock
         for i in range(self.processor_layers):
-            e_mesh = MeshEdgeBlock(L, self.comm, dtype=self.dtype, name=f"proc_edge_{i}")(
+            e_mesh = EdgeB(L, self.comm, dtype=self.dtype, name=f"proc_edge_{i}")(
                 e_mesh, m, m, plans["mesh"]
             )
-            m = MeshNodeBlock(L, self.comm, dtype=self.dtype, name=f"proc_node_{i}")(
+            m = NodeB(L, self.comm, dtype=self.dtype, name=f"proc_node_{i}")(
                 m, e_mesh, plans["mesh"]
             )
 

@@ -24,6 +24,8 @@ every collective / model picks it up.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -54,6 +56,112 @@ def row_take(x: jax.Array, idx: jax.Array, col_block: int | None = None) -> jax.
     )
 
 
+def _col_split_take(x: jax.Array, idx: jax.Array, col_block: int) -> jax.Array:
+    """``jnp.take(x, idx, axis=0, mode="fill")`` in <=col_block-wide column
+    passes (OOB rows -> 0)."""
+    F = x.shape[-1]
+    if not col_block or F <= col_block:
+        return jnp.take(x, idx, axis=0, mode="fill", fill_value=0)
+    return jnp.concatenate(
+        [
+            jnp.take(x[:, j : j + col_block], idx, axis=0, mode="fill", fill_value=0)
+            for j in range(0, F, col_block)
+        ],
+        axis=-1,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _make_take_rows(n_rows, sorted_ids, col_block, pallas, block_e, block_n, mc):
+    """Row gather whose VJP is an explicitly-routed segment reduction.
+
+    JAX's default transpose of ``x[idx]`` is a generic XLA scatter-add —
+    measured 56 ms for [2.33M, 256] f32 on v5e, ~2x slower than a
+    sorted-segment reduction and blind to both the plan's monotone owner
+    ordering and the >128-lane gather cliff. This wrapper pins the
+    backward to the same fast paths the forward collectives use (the
+    reference hand-writes these transposes for the identical reason,
+    ``_torch_func_impl.py:112-191``):
+      - sorted ids + Pallas available -> one-hot MXU sorted_segment_sum
+      - otherwise -> jax.ops.segment_sum (with the sortedness hint)
+    """
+
+    @jax.custom_vjp
+    def take(x, idx):
+        return _col_split_take(x, idx, col_block)
+
+    def fwd(x, idx):
+        return take(x, idx), idx
+
+    def bwd(idx, g):
+        if pallas:
+            from dgraph_tpu.ops.pallas_segment import sorted_segment_sum
+
+            prec = "default" if g.dtype == jnp.bfloat16 else "highest"
+            dx = sorted_segment_sum(
+                g, idx, n_rows, max_chunks_per_block=mc,
+                block_e=block_e, block_n=block_n, precision=prec,
+            )
+        else:
+            dx = jax.ops.segment_sum(
+                g, idx, num_segments=n_rows, indices_are_sorted=sorted_ids
+            )
+        return dx, None
+
+    take.defvjp(fwd, bwd)
+    return take
+
+
+def take_rows(
+    x: jax.Array,
+    idx: jax.Array,
+    *,
+    indices_are_sorted: bool = False,
+    col_block: int | None = None,
+    pallas_hints: tuple | None = None,  # (block_e, block_n, max_chunks) or None
+) -> jax.Array:
+    """``x[idx]`` row gather with a fast-path VJP (see
+    :func:`_make_take_rows`). Out-of-range ids produce zero rows (padding
+    convention). ``pallas_hints`` enables the sorted one-hot MXU kernel for
+    the backward when ids are monotone (plan-guaranteed)."""
+    if col_block is None:
+        from dgraph_tpu import config as _cfg
+
+        col_block = _cfg.gather_col_block
+    use_pallas = (
+        pallas_hints is not None
+        and indices_are_sorted
+        and jax.default_backend() == "tpu"
+    )
+    be, bn, mc = pallas_hints if use_pallas else (0, 0, 0)
+    return _make_take_rows(
+        x.shape[0], indices_are_sorted, col_block, use_pallas, be, bn, mc
+    )(x, idx)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_segment_sum(num_segments, sorted_ids, col_block):
+    """segment_sum whose VJP is a column-split take (the >128-lane row
+    gather cliff applies to the backward's ``g[ids]`` exactly as it does to
+    forward gathers — measured 28.9 ms plain vs 4.3 ms col-split for
+    [2.33M, 256] f32 on v5e)."""
+
+    @jax.custom_vjp
+    def segsum(data, ids):
+        return jax.ops.segment_sum(
+            data, ids, num_segments=num_segments, indices_are_sorted=sorted_ids
+        )
+
+    def fwd(data, ids):
+        return segsum(data, ids), ids
+
+    def bwd(ids, g):
+        return _col_split_take(g, ids, col_block), None
+
+    segsum.defvjp(fwd, bwd)
+    return segsum
+
+
 def masked_gather(src: jax.Array, idx: jax.Array, mask: jax.Array) -> jax.Array:
     """out[i] = src[idx[i]] * mask[i] — ``Rank_Local_Gather_Kernel`` parity."""
     return row_take(src, idx) * mask[..., None]
@@ -80,7 +188,16 @@ def segment_sum(
     The TPU replacement for atomicAdd scatter (``local_data_kernels.cuh:208-253``).
     ``indices_are_sorted=True`` (plan-guaranteed when
     ``EdgePlan.owner_sorted``) lets XLA use the cheaper monotone-scatter path.
+
+    For [E, F] data the VJP is pinned to a column-split take
+    (:func:`_make_segment_sum`) instead of JAX's default plain gather.
     """
+    if data.ndim == 2:
+        from dgraph_tpu import config as _cfg
+
+        return _make_segment_sum(
+            num_segments, indices_are_sorted, _cfg.gather_col_block
+        )(data, segment_ids)
     return jax.ops.segment_sum(
         data,
         segment_ids,

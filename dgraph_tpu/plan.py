@@ -476,6 +476,14 @@ class EdgePlanLayout:
     dst_counts: np.ndarray  # [W]
 
 
+# v5e-tuned Pallas scatter tiles (ops.pallas_segment): block_e=1024 measured
+# 29.0 ms vs 512's 34.1 ms for [2.33M, 256] f32 sorted segment-sum
+# (logs/kernels_r2.jsonl). New plans carry these; old pickled plans keep the
+# blocks they were built with (EdgePlan field defaults + PLAN_FORMAT_VERSION).
+SCATTER_BLOCK_E = 1024
+SCATTER_BLOCK_N = 256
+
+
 def _pad_to(x: int, multiple: int) -> int:
     if multiple <= 1:
         return max(x, 1)
@@ -649,7 +657,7 @@ def build_edge_plan(
         dst_idx_arr = to_padded(halo_side_local_idx.astype(np.int32), np.int32)
 
     owner_idx_arr = dst_idx_arr if edge_owner == "dst" else src_idx_arr
-    scatter_block_e, scatter_block_n = 512, 256  # v5e-tuned (ops.pallas_segment)
+    scatter_block_e, scatter_block_n = SCATTER_BLOCK_E, SCATTER_BLOCK_N
     if sort_edges:
         from dgraph_tpu.ops.pallas_segment import max_chunks_hint
 

@@ -75,7 +75,7 @@ def restore_checkpoint(ckpt_dir: str, template: dict, step: Optional[int] = None
 # Bump whenever EdgePlan's fields/defaults change shape or meaning: stale
 # cache pickles must REBUILD, not silently inherit new class defaults for
 # fields they were never built with (e.g. scatter_block_e).
-PLAN_FORMAT_VERSION = 2
+PLAN_FORMAT_VERSION = 3  # v3: scatter_block_e default 512 -> 1024
 
 
 def _graph_fingerprint(edge_index: np.ndarray, partition: np.ndarray, **kw) -> str:
