@@ -52,6 +52,18 @@ def log(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
+def _make_runner(scan_fn):
+    """(params, opt_state, salt), n -> new state; the trailing float(s)
+    scalar fetch is the only trustworthy completion barrier on the tunnel."""
+
+    def run(state, n):
+        p, o, s = scan_fn(*state, n)
+        float(s)
+        return (p, o, s)
+
+    return run
+
+
 def _timed_scan_ms(epochs_fn, state, n_long, reps=3, max_rounds=6):
     """Median positive (long-short)/(n_long-1) delta in ms; retries noisy
     rounds, returns (ms, state) or (nan, state) if the tunnel never yields a
@@ -73,7 +85,10 @@ def _timed_scan_ms(epochs_fn, state, n_long, reps=3, max_rounds=6):
             deltas.append(d)
     if not deltas:
         return float("nan"), state
-    return sorted(deltas)[len(deltas) // 2], state
+    ds = sorted(deltas)
+    mid = len(ds) // 2
+    median = ds[mid] if len(ds) % 2 else (ds[mid - 1] + ds[mid]) / 2
+    return median, state
 
 
 def pallas_selfcheck() -> bool:
@@ -184,12 +199,7 @@ def bench_gcn(dtype_name: str):
     N_LONG = 6
     log(f"compiling (n=1 and n={N_LONG})...")
     state = (params, opt_state, jnp.float32(0.0))
-
-    def run(state, n):
-        p, o, s = epochs(*state, n)
-        float(s)  # scalar fetch = the only trustworthy completion barrier
-        return (p, o, s)
-
+    run = _make_runner(epochs)
     state = run(state, 1)
     state = run(state, N_LONG)
     log("warmup done; timing...")
@@ -300,11 +310,7 @@ def bench_graphcast(dtype_name: str):
         (p, o, s), _ = jax.lax.scan(body, (params, opt_state, salt), None, length=n)
         return p, o, s
 
-    def run(state, n):
-        p, o, s = steps(*state, n)
-        float(s)
-        return (p, o, s)
-
+    run = _make_runner(steps)
     state = (params, opt_state, jnp.float32(0.0))
     state = run(state, 1)
     state = run(state, 4)
@@ -340,7 +346,7 @@ def main():
         except Exception as e:  # stage-2 failure must not kill the metric
             log(f"graphcast stage failed: {type(e).__name__}: {e}")
 
-    vs = 1.0
+    vs = None  # null when there is no measurement (don't imply parity)
     base_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json"
     )
@@ -348,7 +354,7 @@ def main():
         try:
             base = json.load(open(base_path))
             if base.get("unit") == "ms" and base.get("value"):
-                vs = float(base["value"]) / dt_ms  # >1 = faster than recorded
+                vs = round(float(base["value"]) / dt_ms, 4)  # >1 = faster
         except Exception:
             pass
 
@@ -356,7 +362,7 @@ def main():
         "metric": "arxiv_gcn_epoch_time",
         "value": round(dt_ms, 3) if dt_ms == dt_ms else None,
         "unit": "ms",
-        "vs_baseline": round(vs, 4),
+        "vs_baseline": vs,
         **roof,
         "graphcast_step_ms": round(gc_ms, 2) if gc_ms == gc_ms else None,
         "graphcast_config": gc_info,

@@ -30,44 +30,43 @@ import jax
 import jax.numpy as jnp
 
 
-def row_take(x: jax.Array, idx: jax.Array, col_block: int | None = None) -> jax.Array:
+def row_take(
+    x: jax.Array,
+    idx: jax.Array,
+    col_block: int | None = None,
+    *,
+    oob: str = "clamp",  # "clamp" (x[idx] semantics) | "fill" (OOB rows -> 0)
+) -> jax.Array:
     """``x[idx]`` for [N, F] row gathers, split into <=``col_block``-wide
     column chunks.
 
     XLA's TPU row-gather fast path covers one (8,128) lane tile per row;
-    rows wider than 128 f32 lanes fall off it (measured ~7x slower at F=256
-    on v5e). Chunking the minor dim keeps every piece on the fast path —
-    the TPU analogue of the reference's float4-vectorized gather
+    rows wider than 128 f32 lanes fall off it (measured 28.9 ms plain vs
+    4.3 ms split for [2.33M, 256] f32 on v5e, logs/kernels_r2.jsonl).
+    Chunking the minor dim keeps every piece on the fast path — the TPU
+    analogue of the reference's float4-vectorized gather
     (``local_data_kernels.cuh:353-406``): reshape the access so the memory
     system moves full-width units.
 
     ``col_block=None`` reads :data:`dgraph_tpu.config.gather_col_block`;
-    0 disables splitting.
+    0 disables splitting. ``oob="fill"`` zeroes out-of-range rows (the
+    padding convention VJPs need); "clamp" keeps plain-indexing semantics.
     """
     if col_block is None:
         from dgraph_tpu import config as _cfg
 
         col_block = _cfg.gather_col_block
+
+    def one(chunk):
+        if oob == "fill":
+            return jnp.take(chunk, idx, axis=0, mode="fill", fill_value=0)
+        return chunk[idx]
+
     F = x.shape[-1]
     if not col_block or F <= col_block:
-        return x[idx]
+        return one(x)
     return jnp.concatenate(
-        [x[..., j : j + col_block][idx] for j in range(0, F, col_block)], axis=-1
-    )
-
-
-def _col_split_take(x: jax.Array, idx: jax.Array, col_block: int) -> jax.Array:
-    """``jnp.take(x, idx, axis=0, mode="fill")`` in <=col_block-wide column
-    passes (OOB rows -> 0)."""
-    F = x.shape[-1]
-    if not col_block or F <= col_block:
-        return jnp.take(x, idx, axis=0, mode="fill", fill_value=0)
-    return jnp.concatenate(
-        [
-            jnp.take(x[:, j : j + col_block], idx, axis=0, mode="fill", fill_value=0)
-            for j in range(0, F, col_block)
-        ],
-        axis=-1,
+        [one(x[..., j : j + col_block]) for j in range(0, F, col_block)], axis=-1
     )
 
 
@@ -88,7 +87,7 @@ def _make_take_rows(n_rows, sorted_ids, col_block, pallas, block_e, block_n, mc)
 
     @jax.custom_vjp
     def take(x, idx):
-        return _col_split_take(x, idx, col_block)
+        return row_take(x, idx, col_block, oob="fill")
 
     def fwd(x, idx):
         return take(x, idx), idx
@@ -156,7 +155,7 @@ def _make_segment_sum(num_segments, sorted_ids, col_block):
         return segsum(data, ids), ids
 
     def bwd(ids, g):
-        return _col_split_take(g, ids, col_block), None
+        return row_take(g, ids, col_block, oob="fill"), None
 
     segsum.defvjp(fwd, bwd)
     return segsum

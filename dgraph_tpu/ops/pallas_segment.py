@@ -166,26 +166,11 @@ def _make_sss(num_segments, max_chunks_per_block, block_e, block_n, interpret,
     def bwd(res, g):
         segment_ids, data = res
         # column-chunked take: the same >128-lane row-gather cliff the
-        # forward path avoids (ops.local.row_take) applies to the grad
-        # gather — keep every piece on XLA's one-tile fast path. Uses the
-        # same config knob as row_take so the split policy can't drift.
-        from dgraph_tpu import config as _cfg
+        # forward path avoids applies to the grad gather (shared impl:
+        # ops.local.row_take, OOB ids -> zero grad rows)
+        from dgraph_tpu.ops.local import row_take
 
-        F = g.shape[-1]
-        cb = _cfg.gather_col_block
-        if not cb or F <= cb:
-            gd = jnp.take(g, segment_ids, axis=0, mode="fill", fill_value=0)
-        else:
-            gd = jnp.concatenate(
-                [
-                    jnp.take(
-                        g[:, j : j + cb], segment_ids, axis=0,
-                        mode="fill", fill_value=0,
-                    )
-                    for j in range(0, F, cb)
-                ],
-                axis=-1,
-            )
+        gd = row_take(g, segment_ids, oob="fill")
         if input_op == "relu":
             gd = gd * (data > 0).astype(gd.dtype)
         return gd, None

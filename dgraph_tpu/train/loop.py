@@ -93,12 +93,20 @@ def make_train_step(
     *,
     loss_fn: Callable = masked_cross_entropy,
     donate: bool = True,
+    per_replica_batch: bool = False,
 ):
     """Build a jitted SPMD train step: (params, opt_state, batch, plan) ->
     (params, opt_state, metrics).
 
     ``batch`` is a dict pytree with leading-[W] leaves (from
     ``DistributedGraph.batch`` + labels); params/opt_state are replicated.
+
+    ``per_replica_batch=True``: batch leaves carry a leading [R, W, ...]
+    pair of axes and each replica group trains on its OWN sample (see
+    :class:`~dgraph_tpu.train.sampler.ReplicaSampler` — the reference's
+    ``CommAwareDistributedSampler`` semantics, ``dist_utils.py:50-113``).
+    With False (default), all replicas see the same batch and data
+    parallelism degenerates to scaled-loss replication.
     """
 
     # replica-axis size (data parallelism): grads auto-psum over EVERY axis
@@ -106,10 +114,22 @@ def make_train_step(
     # the replica-sum into the DDP mean (graph-axis contributions are partial
     # sums of one sample and must stay a sum).
     num_replicas = dict(mesh.shape).get(REPLICA_AXIS, 1)
+    batch_spec = (
+        P(REPLICA_AXIS, GRAPH_AXIS) if per_replica_batch else P(GRAPH_AXIS)
+    )
+
+    def _squeeze_batch(batch):
+        # drop the size-1 per-shard leading axes shard_map leaves on each
+        # leaf: [1, n, ...] (shared batch) or [1, 1, n, ...] (per-replica)
+        n_lead = 2 if per_replica_batch else 1
+        out = batch
+        for _ in range(n_lead):
+            out = jax.tree.map(lambda leaf: leaf[0], out)
+        return out
 
     def shard_body(params, batch, plan):
         plan = squeeze_plan(plan)
-        b = jax.tree.map(lambda leaf: leaf[0], batch)
+        b = _squeeze_batch(batch)
 
         def lf(p):
             logits = model.apply(p, *_batch_args(b, plan))
@@ -133,12 +153,16 @@ def make_train_step(
         acc = lax.psum(correct, GRAPH_AXIS) / jnp.maximum(
             lax.psum(b["mask"].sum(), GRAPH_AXIS), 1.0
         )
+        if per_replica_batch:
+            # distinct samples: report the replica-mean metrics (out_specs
+            # P() requires values statically replicated over the replica
+            # axis — also when its size is 1)
+            loss = lax.pmean(loss, REPLICA_AXIS)
+            acc = lax.pmean(acc, REPLICA_AXIS)
         return grads, {"loss": loss, "accuracy": acc}
 
-    batch_template_specs = None  # resolved at call time from the batch tree
-
     def step(params, opt_state, batch, plan):
-        batch_specs = jax.tree.map(lambda _: P(GRAPH_AXIS), batch)
+        batch_specs = jax.tree.map(lambda _: batch_spec, batch)
         grads, metrics = jax.shard_map(
             shard_body,
             mesh=mesh,

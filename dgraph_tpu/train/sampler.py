@@ -1,0 +1,54 @@
+"""Replica-axis data sampling — the reference's
+``CommAwareDistributedSampler`` (``experiments/GraphCast/dist_utils.py:50-113``)
+re-designed for a 2-D device mesh.
+
+The reference assigns every rank in a partition group the SAME sample and
+different groups DIFFERENT samples by integer rank arithmetic
+(``sample_idx = indices[batch * num_groups + partition_id]``). On TPU the
+grouping is the mesh itself: the ``graph`` axis holds one sample's vertex
+shards, the ``replica`` axis holds independent samples. This sampler
+produces, for global step ``t``, the R sample indices for the replica axis
+and stacks their sharded batches into leading-[R, W, ...] arrays to be fed
+with ``in_specs P(REPLICA_AXIS, GRAPH_AXIS)``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ReplicaSampler:
+    """Deterministic epoch-shuffled sampler over ``num_samples`` items for
+    ``num_replicas`` replica groups.
+
+    Matches the reference semantics: an epoch is a seeded permutation of
+    the dataset; step ``t`` within an epoch hands replica ``r`` the item
+    ``perm[t * R + r]``; a short final step wraps (drop_last=False
+    behavior via modulo)."""
+
+    def __init__(self, num_samples: int, num_replicas: int, seed: int = 0):
+        if num_samples < 1:
+            raise ValueError("num_samples must be >= 1")
+        self.num_samples = num_samples
+        self.num_replicas = num_replicas
+        self.seed = seed
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return max(1, -(-self.num_samples // self.num_replicas))
+
+    def indices(self, global_step: int) -> list[int]:
+        """Sample index for each replica at this global step."""
+        epoch, t = divmod(int(global_step), self.steps_per_epoch)
+        rng = np.random.default_rng((self.seed, epoch))
+        perm = rng.permutation(self.num_samples)
+        base = t * self.num_replicas
+        return [int(perm[(base + r) % self.num_samples]) for r in range(self.num_replicas)]
+
+    def stacked(self, global_step: int, get_sharded):
+        """Fetch + stack: ``get_sharded(i) -> pytree of [W, ...] leaves``
+        becomes a pytree of [R, W, ...] leaves (one sample per replica)."""
+        import jax
+
+        parts = [get_sharded(i) for i in self.indices(global_step)]
+        return jax.tree.map(lambda *leaves: np.stack(leaves, axis=0), *parts)

@@ -1,0 +1,131 @@
+"""Distinct-samples data parallelism: the hybrid (replica x graph) mesh
+trains on DIFFERENT samples per replica group and its gradient equals the
+mean of the per-sample gradients — the semantics the reference builds with
+``ranks_per_graph`` partition groups + ``CommAwareDistributedSampler``
+(``NCCLBackendEngine.py:56-64``, ``GraphCast/dist_utils.py:50-113``).
+
+Equivalence pinned (VERDICT r1 #6): one step on a 2x4 mesh with samples
+(s0, s1) assigned to the two replica groups == one step on a 1x4 mesh with
+the two samples' gradients averaged sequentially.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dgraph_tpu.comm import Communicator
+from dgraph_tpu.comm.mesh import make_graph_mesh
+from dgraph_tpu.data import DistributedGraph, synthetic
+from dgraph_tpu.models import GCN
+from dgraph_tpu.train.loop import init_params, make_train_step
+from dgraph_tpu.train.sampler import ReplicaSampler
+
+
+def _graph(world):
+    data = synthetic.sbm_classification_graph(
+        num_nodes=256, num_classes=4, feat_dim=8, avg_degree=6.0, seed=3
+    )
+    return DistributedGraph.from_global(
+        data["edge_index"],
+        data["features"],
+        data["labels"],
+        data["masks"],
+        world_size=world,
+        partition_method="random",
+        add_symmetric_norm=True,
+    )
+
+
+def _sample_batch(g, seed):
+    """Same topology, per-sample features/labels (the GraphCast pattern:
+    static graph, varying fields)."""
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal(g.features.shape).astype(np.float32)
+    y = (rng.random(g.labels.shape) * 4).astype(g.labels.dtype)
+    return {
+        "x": x,
+        "y": y,
+        "mask": np.asarray(g.masks["train"]),
+        "edge_weight": np.asarray(g.edge_weight),
+    }
+
+
+class TestReplicaSampler:
+    def test_distinct_indices_across_replicas(self):
+        s = ReplicaSampler(num_samples=8, num_replicas=2, seed=0)
+        idx = s.indices(0)
+        assert len(idx) == 2 and idx[0] != idx[1]
+
+    def test_epoch_covers_all_samples(self):
+        s = ReplicaSampler(num_samples=8, num_replicas=2, seed=0)
+        seen = set()
+        for t in range(s.steps_per_epoch):
+            seen.update(s.indices(t))
+        assert seen == set(range(8))
+
+    def test_different_epochs_reshuffle(self):
+        s = ReplicaSampler(num_samples=16, num_replicas=2, seed=0)
+        e0 = [tuple(s.indices(t)) for t in range(s.steps_per_epoch)]
+        e1 = [tuple(s.indices(t + s.steps_per_epoch)) for t in range(s.steps_per_epoch)]
+        assert e0 != e1
+
+    def test_stacked_shapes(self):
+        s = ReplicaSampler(num_samples=4, num_replicas=2, seed=0)
+        got = s.stacked(0, lambda i: {"x": np.full((3, 5), i, np.float32)})
+        assert got["x"].shape == (2, 3, 5)
+        i0, i1 = s.indices(0)
+        assert got["x"][0, 0, 0] == i0 and got["x"][1, 0, 0] == i1
+
+
+def test_hybrid_mesh_equals_sequential_accumulation():
+    """2 replicas x 4 shards, distinct samples == mean of the two samples'
+    gradients on a 1x4 mesh (SGD(1.0) makes param deltas = -grad)."""
+    W = 4
+    g = _graph(W)
+    plan = jax.tree.map(jnp.asarray, g.plan)
+    comm = Communicator.init_process_group("tpu", world_size=W, replica_axis="replica")
+    model = GCN(hidden_features=16, out_features=4, comm=comm)
+    opt = optax.sgd(1.0)
+
+    b0 = _sample_batch(g, seed=10)
+    b1 = _sample_batch(g, seed=11)
+
+    # --- reference: sequential two-sample accumulation on 1x4 ---
+    mesh_seq = make_graph_mesh(ranks_per_graph=W, num_replicas=1,
+                               devices=jax.devices()[:W])
+    params = init_params(model, mesh_seq, plan, jax.tree.map(jnp.asarray, b0))
+    # host copies: params/plan must not carry the 1x4 mesh into the 2x4 step
+    params = jax.device_get(params)
+    step_seq = make_train_step(model, opt, mesh_seq, plan, donate=False)
+    deltas = []
+    with jax.set_mesh(mesh_seq):
+        for b in (b0, b1):
+            p2, _, _ = step_seq(params, opt.init(params),
+                                jax.tree.map(jnp.asarray, b), plan)
+            deltas.append(jax.device_get(jax.tree.map(lambda a, b_: b_ - a, params, p2)))
+    want = jax.tree.map(lambda a, b_: (a + b_) / 2, *deltas)
+
+    # --- hybrid: one step on 2x4 with per-replica batches ---
+    mesh_h = make_graph_mesh(ranks_per_graph=W, num_replicas=2)
+    sampler = ReplicaSampler(num_samples=2, num_replicas=2, seed=0)
+    batches = [b0, b1]
+    stacked = sampler.stacked(0, lambda i: batches[i])
+    # identity permutation not guaranteed; build want accordingly
+    i0, i1 = sampler.indices(0)
+    assert {i0, i1} == {0, 1}
+    plan_h = jax.tree.map(lambda leaf: jnp.asarray(np.asarray(leaf)), plan)
+    step_h = make_train_step(model, opt, mesh_h, plan_h, donate=False,
+                             per_replica_batch=True)
+    with jax.set_mesh(mesh_h):
+        p2, _, metrics = step_h(params, opt.init(params),
+                                jax.tree.map(jnp.asarray, stacked), plan_h)
+    got = jax.tree.map(lambda a, b_: b_ - a, params, p2)
+
+    flat_w = jax.tree.leaves(want)
+    flat_g = jax.tree.leaves(got)
+    for w, gg in zip(flat_w, flat_g):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(w), rtol=2e-4, atol=2e-5)
+    assert np.isfinite(float(metrics["loss"]))
