@@ -373,6 +373,270 @@ int64_t unique_encoded_pairs(const int64_t* keys, const int64_t* vals,
   return m;
 }
 
+// ---------------------------------------------------------------------------
+// Streaming edge-plan core for billion-edge graphs (SURVEY §7 "papers100M
+// plan build"; the reference precomputes per-rank plans offline and caches
+// them to disk for MAG240M, MAG240M_dataset.py:237-260).
+//
+// The numpy builder (dgraph_tpu/plan.py build_edge_plan) lexsorts and
+// np.uniques over all E edges with ~10 int64 temporaries — at E=1.6e9
+// that's >100 GB of transients on this single-core host. This core does
+// the same computation with counting/radix sorts and bounded buffers:
+//   1. owner rank per edge + counting sort by owner,
+//   2. per-rank LSD radix sort by owner-side local vertex id (monotone
+//      segment ids for the sorted-scatter kernels),
+//   3. cross-edge (needer, halo-vid) pair sort + run-length dedup, with
+//      halo-slot ids propagated back to edges during the scan (no
+//      binary-search pass),
+//   4. direct fill of the padded [W, E_pad] / [W, W, S_pad] plan arrays.
+// Two-call protocol: begin() computes sizes (caller picks padding and
+// allocates numpy outputs), fill() writes them, free() drops the context.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct PlanCtx {
+  int64_t E = 0;
+  int32_t W = 0;
+  int edge_owner_dst = 1;
+  std::vector<int32_t> owner;      // [E]
+  std::vector<int64_t> e_counts;   // [W]
+  std::vector<int32_t> edge_slot;  // [E] slot within owner rank (sorted order)
+  std::vector<int64_t> halo_counts;  // [W*W] (sender, needer)
+  std::vector<int32_t> edge_pair;  // [E] unique-pair id per cross edge, -1 local
+  // per unique (needer, vid) pair, sorted by (needer, vid):
+  std::vector<int64_t> pair_vid;
+  std::vector<int32_t> pair_needer, pair_sender, pair_pos;
+};
+
+// LSD radix sort of (key, val) arrays by key, 8 bits per pass.
+void radix_sort_u64(std::vector<uint64_t>& keys, std::vector<uint32_t>& vals,
+                    uint64_t max_key) {
+  int passes = 0;
+  while (max_key >> (8 * passes)) ++passes;
+  if (passes == 0) passes = 1;
+  size_t n = keys.size();
+  std::vector<uint64_t> kbuf(n);
+  std::vector<uint32_t> vbuf(n);
+  for (int p = 0; p < passes; ++p) {
+    size_t count[257] = {0};
+    int shift = 8 * p;
+    for (size_t i = 0; i < n; ++i) ++count[((keys[i] >> shift) & 0xff) + 1];
+    for (int b = 0; b < 256; ++b) count[b + 1] += count[b];
+    for (size_t i = 0; i < n; ++i) {
+      size_t pos = count[(keys[i] >> shift) & 0xff]++;
+      kbuf[pos] = keys[i];
+      vbuf[pos] = vals[i];
+    }
+    keys.swap(kbuf);
+    vals.swap(vbuf);
+  }
+}
+
+}  // namespace
+
+// Phase 1: sort + halo analysis. Returns an opaque context; writes
+// out_sizes = {max per-rank edge count, max per-(sender,needer) halo count,
+// unique halo pairs, cross edge count}.
+void* plan_core_begin(const int64_t* src, const int64_t* dst, int64_t E,
+                      const int32_t* src_part, const int32_t* dst_part,
+                      const int64_t* src_offsets, const int64_t* dst_offsets,
+                      int64_t v_src, int64_t v_dst, int32_t W,
+                      int32_t edge_owner_dst, int64_t* out_sizes) {
+  // edge ids travel as uint32 through the radix sorts; past 2^32 edges
+  // they would wrap and silently corrupt the plan — refuse instead
+  if (E >= (int64_t(1) << 32)) return nullptr;
+  auto* ctx = new PlanCtx();
+  ctx->E = E;
+  ctx->W = W;
+  ctx->edge_owner_dst = edge_owner_dst;
+  const int64_t* owner_vid = edge_owner_dst ? dst : src;
+  const int64_t* halo_vid = edge_owner_dst ? src : dst;
+  const int32_t* owner_part = edge_owner_dst ? dst_part : src_part;
+  const int32_t* halo_part = edge_owner_dst ? src_part : dst_part;
+  const int64_t* owner_off = edge_owner_dst ? dst_offsets : src_offsets;
+
+  // 1. owner rank per edge + counts
+  ctx->owner.resize(E);
+  ctx->e_counts.assign(W, 0);
+  for (int64_t e = 0; e < E; ++e) {
+    int32_t r = owner_part[owner_vid[e]];
+    ctx->owner[e] = r;
+    ++ctx->e_counts[r];
+  }
+
+  // 2. stable counting sort by owner, then per-rank radix by local owner vid
+  std::vector<int64_t> rank_start(W + 1, 0);
+  for (int32_t r = 0; r < W; ++r) rank_start[r + 1] = rank_start[r] + ctx->e_counts[r];
+  ctx->edge_slot.resize(E);
+  {
+    std::vector<int64_t> cur(rank_start.begin(), rank_start.end() - 1);
+    // bucket pass: per-rank (local_vid, orig_idx) entries
+    std::vector<uint64_t> bkeys(E);
+    std::vector<uint32_t> bvals(E);
+    for (int64_t e = 0; e < E; ++e) {
+      int32_t r = ctx->owner[e];
+      int64_t pos = cur[r]++;
+      bkeys[pos] = static_cast<uint64_t>(owner_vid[e] - owner_off[r]);
+      bvals[pos] = static_cast<uint32_t>(e);
+    }
+    for (int32_t r = 0; r < W; ++r) {
+      int64_t lo = rank_start[r], n = ctx->e_counts[r];
+      if (n == 0) continue;
+      uint64_t max_local = 0;
+      for (int64_t i = lo; i < lo + n; ++i) max_local = std::max(max_local, bkeys[i]);
+      std::vector<uint64_t> k(bkeys.begin() + lo, bkeys.begin() + lo + n);
+      std::vector<uint32_t> v(bvals.begin() + lo, bvals.begin() + lo + n);
+      radix_sort_u64(k, v, max_local);
+      for (int64_t i = 0; i < n; ++i) ctx->edge_slot[v[i]] = static_cast<int32_t>(i);
+    }
+  }
+
+  // 3. cross-pair dedup with slot propagation
+  int64_t n_cross = 0;
+  for (int64_t e = 0; e < E; ++e)
+    if (halo_part[halo_vid[e]] != ctx->owner[e]) ++n_cross;
+  ctx->edge_pair.assign(E, -1);
+  ctx->halo_counts.assign(static_cast<size_t>(W) * W, 0);
+  int64_t v_halo = edge_owner_dst ? v_src : v_dst;
+  const int64_t* halo_off = edge_owner_dst ? src_offsets : dst_offsets;
+  if (n_cross > 0) {
+    // bucket by needer (= owner) first so the per-bucket radix ping-pong
+    // buffers are ~1/W of n_cross (a full-width sort's transient is ~24
+    // bytes/cross-edge — tens of GB at papers100M scale)
+    std::vector<int64_t> nc_counts(W, 0);
+    for (int64_t e = 0; e < E; ++e)
+      if (halo_part[halo_vid[e]] != ctx->owner[e]) ++nc_counts[ctx->owner[e]];
+    std::vector<int64_t> nc_start(W + 1, 0);
+    for (int32_t r = 0; r < W; ++r) nc_start[r + 1] = nc_start[r] + nc_counts[r];
+    std::vector<uint64_t> keys(n_cross);
+    std::vector<uint32_t> vals(n_cross);
+    {
+      std::vector<int64_t> cur(nc_start.begin(), nc_start.end() - 1);
+      for (int64_t e = 0; e < E; ++e) {
+        int64_t hv = halo_vid[e];
+        int32_t r = ctx->owner[e];
+        if (halo_part[hv] != r) {
+          int64_t pos = cur[r]++;
+          keys[pos] = static_cast<uint64_t>(hv);
+          vals[pos] = static_cast<uint32_t>(e);
+        }
+      }
+    }
+    for (int32_t r = 0; r < W; ++r) {
+      int64_t lo = nc_start[r], n = nc_counts[r];
+      if (n == 0) continue;
+      std::vector<uint64_t> k(keys.begin() + lo, keys.begin() + lo + n);
+      std::vector<uint32_t> v(vals.begin() + lo, vals.begin() + lo + n);
+      radix_sort_u64(k, v, static_cast<uint64_t>(v_halo));
+      std::copy(k.begin(), k.end(), keys.begin() + lo);
+      std::copy(v.begin(), v.end(), vals.begin() + lo);
+    }
+    // re-encode to global (needer, vid) keys for the run-length scan
+    for (int32_t r = 0; r < W; ++r)
+      for (int64_t i = nc_start[r]; i < nc_start[r + 1]; ++i)
+        keys[i] += static_cast<uint64_t>(r) * v_halo;
+    // exact reserve (push_back doubling would spike ~2x at H ~ 1e8+)
+    int64_t H_total = n_cross > 0 ? 1 : 0;
+    for (int64_t i = 1; i < n_cross; ++i) H_total += keys[i] != keys[i - 1];
+    ctx->pair_vid.reserve(H_total);
+    ctx->pair_needer.reserve(H_total);
+    ctx->pair_sender.reserve(H_total);
+    ctx->pair_pos.reserve(H_total);
+    // run-length scan: assign pair ids; pos within (needer, sender) run
+    int64_t H = 0;
+    int32_t run_needer = -1, run_sender = -1, pos = 0;
+    uint64_t prev_key = ~0ull;
+    for (int64_t i = 0; i < n_cross; ++i) {
+      if (keys[i] != prev_key) {
+        prev_key = keys[i];
+        int32_t needer = static_cast<int32_t>(keys[i] / v_halo);
+        int64_t vid = static_cast<int64_t>(keys[i] % v_halo);
+        int32_t sender = halo_part[vid];
+        if (needer != run_needer || sender != run_sender) {
+          run_needer = needer;
+          run_sender = sender;
+          pos = 0;
+        }
+        ctx->pair_vid.push_back(vid);
+        ctx->pair_needer.push_back(needer);
+        ctx->pair_sender.push_back(sender);
+        ctx->pair_pos.push_back(pos++);
+        ++ctx->halo_counts[static_cast<size_t>(sender) * W + needer];
+        ++H;
+      }
+      ctx->edge_pair[vals[i]] = static_cast<int32_t>(H - 1);
+    }
+    (void)halo_off;
+  }
+
+  int64_t e_max = 0, s_max = 0;
+  for (int32_t r = 0; r < W; ++r) e_max = std::max(e_max, ctx->e_counts[r]);
+  for (auto c : ctx->halo_counts) s_max = std::max(s_max, c);
+  out_sizes[0] = e_max;
+  out_sizes[1] = s_max;
+  out_sizes[2] = static_cast<int64_t>(ctx->pair_vid.size());
+  out_sizes[3] = n_cross;
+  return ctx;
+}
+
+// Phase 2: fill the padded plan arrays (preallocated by the caller).
+void plan_core_fill(void* ctx_, const int64_t* src, const int64_t* dst,
+                    const int64_t* src_offsets, const int64_t* dst_offsets,
+                    int64_t e_pad, int64_t s_pad, int64_t n_owner_pad,
+                    int64_t n_halo_pad, int32_t* src_index, int32_t* dst_index,
+                    float* edge_mask, int32_t* send_idx, float* send_mask,
+                    int64_t* halo_counts_out, int32_t* edge_rank_out,
+                    int64_t* edge_slot_out) {
+  auto* ctx = static_cast<PlanCtx*>(ctx_);
+  const int64_t E = ctx->E;
+  const int32_t W = ctx->W;
+  const int64_t* owner_vid = ctx->edge_owner_dst ? dst : src;
+  const int64_t* halo_vid = ctx->edge_owner_dst ? src : dst;
+  const int64_t* owner_off = ctx->edge_owner_dst ? dst_offsets : src_offsets;
+  const int64_t* halo_off = ctx->edge_owner_dst ? src_offsets : dst_offsets;
+  int32_t* owner_index = ctx->edge_owner_dst ? dst_index : src_index;
+  int32_t* halo_index = ctx->edge_owner_dst ? src_index : dst_index;
+
+  // padding conventions (plan.py build_edge_plan): owner-side padded slots
+  // carry n_owner_pad (monotone tail, dropped by segment reductions);
+  // halo-side and send arrays carry 0 with mask 0
+  std::fill(owner_index, owner_index + static_cast<size_t>(W) * e_pad,
+            static_cast<int32_t>(n_owner_pad));
+  std::memset(halo_index, 0, static_cast<size_t>(W) * e_pad * sizeof(int32_t));
+  std::memset(edge_mask, 0, static_cast<size_t>(W) * e_pad * sizeof(float));
+  std::memset(send_idx, 0, static_cast<size_t>(W) * W * s_pad * sizeof(int32_t));
+  std::memset(send_mask, 0, static_cast<size_t>(W) * W * s_pad * sizeof(float));
+
+  for (int64_t e = 0; e < E; ++e) {
+    int32_t r = ctx->owner[e];
+    int64_t at = static_cast<int64_t>(r) * e_pad + ctx->edge_slot[e];
+    owner_index[at] = static_cast<int32_t>(owner_vid[e] - owner_off[r]);
+    int32_t p = ctx->edge_pair[e];
+    if (p < 0) {
+      halo_index[at] = static_cast<int32_t>(halo_vid[e] - halo_off[r]);
+    } else {
+      halo_index[at] = static_cast<int32_t>(
+          n_halo_pad + static_cast<int64_t>(ctx->pair_sender[p]) * s_pad +
+          ctx->pair_pos[p]);
+    }
+    edge_mask[at] = 1.0f;
+    edge_rank_out[e] = r;
+    edge_slot_out[e] = ctx->edge_slot[e];
+  }
+
+  for (size_t i = 0; i < ctx->pair_vid.size(); ++i) {
+    int32_t s = ctx->pair_sender[i], n = ctx->pair_needer[i];
+    int64_t at = (static_cast<int64_t>(s) * W + n) * s_pad + ctx->pair_pos[i];
+    send_idx[at] = static_cast<int32_t>(ctx->pair_vid[i] - halo_off[s]);
+    send_mask[at] = 1.0f;
+  }
+  std::memcpy(halo_counts_out, ctx->halo_counts.data(),
+              static_cast<size_t>(W) * W * sizeof(int64_t));
+}
+
+void plan_core_free(void* ctx_) { delete static_cast<PlanCtx*>(ctx_); }
+
 // Multi-threaded edge-cut count (partition quality metric at scale).
 int64_t edge_cut_count(const int64_t* src, const int64_t* dst, int64_t num_edges,
                        const int32_t* part) {

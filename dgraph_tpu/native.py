@@ -30,20 +30,29 @@ def _load():
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        if not os.path.exists(_SO):
+        lib = None
+        for attempt in ("load", "rebuild"):
+            if attempt == "rebuild" or not os.path.exists(_SO):
+                try:
+                    subprocess.run(
+                        ["make", "-C", _CSRC] + (["-B"] if attempt == "rebuild" else []),
+                        check=True,
+                        capture_output=True,
+                        timeout=120,
+                    )
+                except Exception:
+                    _build_failed = True
+                    return None
             try:
-                subprocess.run(
-                    ["make", "-C", _CSRC],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except Exception:
-                _build_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+                candidate = ctypes.CDLL(_SO)
+                # a stale prebuilt .so can load but miss newer symbols —
+                # probe one recent entry point before binding signatures
+                candidate.plan_core_begin
+                lib = candidate
+                break
+            except (OSError, AttributeError):
+                continue
+        if lib is None:
             _build_failed = True
             return None
         i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
@@ -64,6 +73,26 @@ def _load():
         lib.unique_encoded_pairs.restype = ctypes.c_int64
         lib.edge_cut_count.argtypes = [i64p, i64p, ctypes.c_int64, i32p]
         lib.edge_cut_count.restype = ctypes.c_int64
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        lib.plan_core_begin.argtypes = [
+            i64p, i64p, ctypes.c_int64,          # src, dst, E
+            i32p, i32p,                          # src_part, dst_part
+            i64p, i64p,                          # src_offsets, dst_offsets
+            ctypes.c_int64, ctypes.c_int64,      # v_src, v_dst
+            ctypes.c_int32, ctypes.c_int32,      # W, edge_owner_dst
+            i64p,                                # out_sizes[4]
+        ]
+        lib.plan_core_begin.restype = ctypes.c_void_p
+        lib.plan_core_fill.argtypes = [
+            ctypes.c_void_p, i64p, i64p, i64p, i64p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            i32p, i32p, f32p,                    # src_index, dst_index, edge_mask
+            i32p, f32p,                          # send_idx, send_mask
+            i64p, i32p, i64p,                    # halo_counts, edge_rank, edge_slot
+        ]
+        lib.plan_core_fill.restype = None
+        lib.plan_core_free.argtypes = [ctypes.c_void_p]
+        lib.plan_core_free.restype = None
         _lib = lib
         return _lib
 
@@ -116,3 +145,59 @@ def edge_cut_count(edge_index: np.ndarray, partition: np.ndarray) -> int:
     dst = np.ascontiguousarray(edge_index[1], np.int64)
     part = np.ascontiguousarray(partition, np.int32)
     return int(lib.edge_cut_count(src, dst, len(src), part))
+
+
+class PlanCore:
+    """Streaming native plan-build core (csrc/dgraph_host.cpp
+    ``plan_core_*``): counting/radix-sort edge ordering + halo-pair dedup
+    with bounded memory, for billion-edge plan builds the numpy path's
+    lexsort/unique temporaries cannot handle (SURVEY §7; the reference's
+    offline per-rank plan precompute, ``MAG240M_dataset.py:237-260``).
+
+    Usage: construct (phase 1: sizes), read ``e_max/s_max/num_pairs``,
+    then ``fill(...)`` into preallocated padded arrays; the context frees
+    on ``close()`` or GC.
+    """
+
+    def __init__(self, src, dst, src_part, dst_part, src_offsets, dst_offsets,
+                 world_size: int, edge_owner: str):
+        lib = _load()
+        assert lib is not None, "native library unavailable"
+        self._lib = lib
+        self._src = np.ascontiguousarray(src, np.int64)
+        self._dst = np.ascontiguousarray(dst, np.int64)
+        self._soff = np.ascontiguousarray(src_offsets, np.int64)
+        self._doff = np.ascontiguousarray(dst_offsets, np.int64)
+        sizes = np.zeros(4, np.int64)
+        self._ctx = lib.plan_core_begin(
+            self._src, self._dst, len(self._src),
+            np.ascontiguousarray(src_part, np.int32),
+            np.ascontiguousarray(dst_part, np.int32),
+            self._soff, self._doff,
+            len(src_part), len(dst_part),
+            world_size, 1 if edge_owner == "dst" else 0, sizes,
+        )
+        assert self._ctx, "plan_core_begin failed"
+        self.e_max, self.s_max, self.num_pairs, self.num_cross = (
+            int(sizes[0]), int(sizes[1]), int(sizes[2]), int(sizes[3]))
+
+    def fill(self, e_pad: int, s_pad: int, n_owner_pad: int, n_halo_pad: int,
+             src_index, dst_index, edge_mask, send_idx, send_mask,
+             halo_counts, edge_rank, edge_slot) -> None:
+        self._lib.plan_core_fill(
+            self._ctx, self._src, self._dst, self._soff, self._doff,
+            e_pad, s_pad, n_owner_pad, n_halo_pad,
+            src_index, dst_index, edge_mask, send_idx, send_mask,
+            halo_counts, edge_rank, edge_slot,
+        )
+
+    def close(self) -> None:
+        if self._ctx:
+            self._lib.plan_core_free(self._ctx)
+            self._ctx = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

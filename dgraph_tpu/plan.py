@@ -483,6 +483,11 @@ class EdgePlanLayout:
 SCATTER_BLOCK_E = 1024
 SCATTER_BLOCK_N = 256
 
+# Edge count above which build_edge_plan dispatches to the native streaming
+# core by default (the numpy path's lexsort/unique int64 temporaries are
+# ~10x E bytes; at papers100M's 1.6e9 edges that exceeds host RAM).
+NATIVE_PLAN_MIN_EDGES = 1 << 24
+
 
 def _pad_to(x: int, multiple: int) -> int:
     if multiple <= 1:
@@ -503,6 +508,7 @@ def build_edge_plan(
     s_pad: Optional[int] = None,
     pad_multiple: int = 8,
     sort_edges: bool = True,
+    use_native: Optional[bool] = None,  # None = auto (E >= NATIVE_PLAN_MIN_EDGES)
 ) -> tuple[EdgePlan, EdgePlanLayout]:
     """Build the padded SPMD plan for one edge set.
 
@@ -524,7 +530,9 @@ def build_edge_plan(
     homogeneous = dst_partition is None
     dst_partition = src_partition if homogeneous else np.asarray(dst_partition)
     W = world_size
-    src, dst = edge_index[0].astype(np.int64), edge_index[1].astype(np.int64)
+    # copy=False: at billion-edge scale a silent astype copy is 26 GB
+    src = edge_index[0].astype(np.int64, copy=False)
+    dst = edge_index[1].astype(np.int64, copy=False)
     E = len(src)
 
     src_counts = np.bincount(src_partition, minlength=W).astype(np.int64)
@@ -536,6 +544,21 @@ def build_edge_plan(
         raise ValueError(
             "partitions must be contiguous per-rank blocks; run "
             "dgraph_tpu.partition.renumber_contiguous first"
+        )
+
+    if edge_owner not in ("src", "dst"):
+        raise ValueError("edge_owner must be 'src' or 'dst'")
+    from dgraph_tpu import native as _native
+
+    if use_native is None:
+        use_native = sort_edges and _native.available() and E >= NATIVE_PLAN_MIN_EDGES
+    if use_native:
+        if not sort_edges:
+            raise ValueError("native plan core always owner-sorts (sort_edges=True)")
+        return _build_edge_plan_native(
+            src, dst, src_partition, dst_partition, src_offsets, dst_offsets,
+            src_counts, dst_counts, W, edge_owner, homogeneous,
+            n_src_pad, n_dst_pad, e_pad, s_pad, pad_multiple,
         )
 
     if edge_owner == "dst":
@@ -656,9 +679,33 @@ def build_edge_plan(
         src_idx_arr = to_padded(own_local.astype(np.int32), np.int32, fill=n_owner_pad)
         dst_idx_arr = to_padded(halo_side_local_idx.astype(np.int32), np.int32)
 
+    return _finalize_plan(
+        src_idx_arr=src_idx_arr, dst_idx_arr=dst_idx_arr, edge_mask=edge_mask,
+        src_counts=src_counts, dst_counts=dst_counts, e_counts=e_counts,
+        send_idx=send_idx, send_mask=send_mask, s_pad_val=S_pad, W=W, E=E,
+        n_src_pad_val=N_src_pad, n_dst_pad_val=N_dst_pad, e_pad_val=E_pad,
+        halo_side=halo_side, homogeneous=homogeneous, edge_owner=edge_owner,
+        owner_sorted=sort_edges,
+        halo_deltas=tuple(int(d) for d in np.unique((needer - sender) % W)),
+        edge_rank=edge_rank, edge_slot=edge_slot, halo_counts=halo_counts,
+        tag="",
+    )
+
+
+def _finalize_plan(
+    *, src_idx_arr, dst_idx_arr, edge_mask, src_counts, dst_counts, e_counts,
+    send_idx, send_mask, s_pad_val, W, E, n_src_pad_val, n_dst_pad_val,
+    e_pad_val, halo_side, homogeneous, edge_owner, owner_sorted, halo_deltas,
+    edge_rank, edge_slot, halo_counts, tag: str,
+) -> tuple[EdgePlan, EdgePlanLayout]:
+    """Shared assembly tail of the numpy and native plan builders: Pallas
+    scheduling hints, EdgePlan/EdgePlanLayout construction, efficiency log.
+    Keeping it in one place means a plan-format change cannot silently
+    diverge between the two paths."""
+    n_owner_pad = n_dst_pad_val if edge_owner == "dst" else n_src_pad_val
     owner_idx_arr = dst_idx_arr if edge_owner == "dst" else src_idx_arr
     scatter_block_e, scatter_block_n = SCATTER_BLOCK_E, SCATTER_BLOCK_N
-    if sort_edges:
+    if owner_sorted:
         from dgraph_tpu.ops.pallas_segment import max_chunks_hint
 
         scatter_mc = max(
@@ -678,18 +725,18 @@ def build_edge_plan(
         num_local_src=src_counts.astype(np.int32),
         num_local_dst=dst_counts.astype(np.int32),
         num_edges=e_counts.astype(np.int32),
-        halo=HaloSpec(send_idx=send_idx, send_mask=send_mask, s_pad=S_pad),
+        halo=HaloSpec(send_idx=send_idx, send_mask=send_mask, s_pad=s_pad_val),
         world_size=W,
-        n_src_pad=N_src_pad,
-        n_dst_pad=N_dst_pad,
-        e_pad=E_pad,
+        n_src_pad=n_src_pad_val,
+        n_dst_pad=n_dst_pad_val,
+        e_pad=e_pad_val,
         halo_side=halo_side,
         homogeneous=homogeneous,
-        owner_sorted=sort_edges,
+        owner_sorted=owner_sorted,
         scatter_mc=scatter_mc,
         scatter_block_e=scatter_block_e,
         scatter_block_n=scatter_block_n,
-        halo_deltas=tuple(int(d) for d in np.unique((needer - sender) % W)),
+        halo_deltas=halo_deltas,
     )
     layout = EdgePlanLayout(
         edge_rank=edge_rank,
@@ -700,13 +747,72 @@ def build_edge_plan(
     )
     eff = plan_efficiency(plan, layout)
     _logger.info(
-        "EdgePlan built: W=%d E=%d e_pad=%d (fill %.3f) s_pad=%d "
+        "EdgePlan built%s: W=%d E=%d e_pad=%d (fill %.3f) s_pad=%d "
         "halo_fill_active=%.3f wire_fill[a2a=%.3f pp=%.3f] deltas=%d -> %s",
-        W, E, E_pad, eff["edge_fill"], S_pad,
+        tag, W, E, e_pad_val, eff["edge_fill"], s_pad_val,
         eff["halo_fill_active"], eff["halo_wire_fill_all_to_all"],
         eff["halo_wire_fill_ppermute"], eff["num_halo_deltas"], eff["halo_impl"],
     )
     return plan, layout
+
+
+def _build_edge_plan_native(
+    src, dst, src_partition, dst_partition, src_offsets, dst_offsets,
+    src_counts, dst_counts, W, edge_owner, homogeneous,
+    n_src_pad, n_dst_pad, e_pad, s_pad, pad_multiple,
+) -> tuple[EdgePlan, EdgePlanLayout]:
+    """Billion-edge path: the per-edge sort/dedup/fill runs in the native
+    core (csrc plan_core_*, bounded-memory radix sorts) and numpy only
+    assembles the (cheap) metadata. Output is identical to the numpy path —
+    pinned by tests/test_plan.py::test_native_plan_matches_numpy."""
+    from dgraph_tpu import native as _native
+
+    E = len(src)
+    core = _native.PlanCore(
+        src, dst, src_partition, dst_partition, src_offsets, dst_offsets,
+        W, edge_owner,
+    )
+    E_pad = e_pad if e_pad is not None else _pad_to(core.e_max, pad_multiple)
+    if core.e_max > E_pad:
+        raise ValueError(f"e_pad={E_pad} < max per-rank edges {core.e_max}")
+    S_pad = s_pad if s_pad is not None else _pad_to(max(core.s_max, 1), pad_multiple)
+    if core.s_max > S_pad:
+        raise ValueError(f"s_pad={S_pad} < max per-peer halo {core.s_max}")
+    N_src_pad = n_src_pad if n_src_pad is not None else _pad_to(int(src_counts.max(initial=1)), pad_multiple)
+    N_dst_pad = n_dst_pad if n_dst_pad is not None else _pad_to(int(dst_counts.max(initial=1)), pad_multiple)
+    halo_side = "src" if edge_owner == "dst" else "dst"
+    n_owner_pad = N_dst_pad if edge_owner == "dst" else N_src_pad
+    N_halo_pad = N_src_pad if halo_side == "src" else N_dst_pad
+
+    src_idx_arr = np.empty((W, E_pad), np.int32)
+    dst_idx_arr = np.empty((W, E_pad), np.int32)
+    edge_mask = np.empty((W, E_pad), np.float32)
+    send_idx = np.empty((W, W, S_pad), np.int32)
+    send_mask = np.empty((W, W, S_pad), np.float32)
+    halo_counts = np.empty((W, W), np.int64)
+    edge_rank = np.empty(E, np.int32)
+    edge_slot = np.empty(E, np.int64)
+    core.fill(
+        E_pad, S_pad, n_owner_pad, N_halo_pad,
+        src_idx_arr, dst_idx_arr, edge_mask.reshape(-1),
+        send_idx.reshape(-1), send_mask.reshape(-1),
+        halo_counts.reshape(-1), edge_rank, edge_slot,
+    )
+    e_counts = np.bincount(edge_rank, minlength=W).astype(np.int64)
+    core.close()
+
+    sender_r, needer_r = np.nonzero(halo_counts)
+    return _finalize_plan(
+        src_idx_arr=src_idx_arr, dst_idx_arr=dst_idx_arr, edge_mask=edge_mask,
+        src_counts=src_counts, dst_counts=dst_counts, e_counts=e_counts,
+        send_idx=send_idx, send_mask=send_mask, s_pad_val=S_pad, W=W, E=E,
+        n_src_pad_val=N_src_pad, n_dst_pad_val=N_dst_pad, e_pad_val=E_pad,
+        halo_side=halo_side, homogeneous=homogeneous, edge_owner=edge_owner,
+        owner_sorted=True,
+        halo_deltas=tuple(int(d) for d in np.unique((needer_r - sender_r) % W)),
+        edge_rank=edge_rank.astype(np.int64), edge_slot=edge_slot,
+        halo_counts=halo_counts, tag=" (native core)",
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -212,3 +212,48 @@ class TestPlanEfficiency:
         # dst-owned edges: the halo flows from src owner r to dst owner r+1
         assert set(plan.halo_deltas) == {1}
         assert eff["halo_impl"] == "ppermute"
+
+
+class TestNativePlanCore:
+    """The native streaming plan core must produce EXACTLY the numpy
+    builder's output (same sort order, same halo slot numbering)."""
+
+    @pytest.mark.parametrize("edge_owner", ["dst", "src"])
+    @pytest.mark.parametrize("hetero", [False, True])
+    def test_native_plan_matches_numpy(self, edge_owner, hetero):
+        from dgraph_tpu import native
+        from dgraph_tpu.plan import build_edge_plan
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(11)
+        W = 4
+        Vs, Vd = 97, 57 if hetero else 97
+        E = 5000
+        src_part = np.sort(rng.integers(0, W, Vs)).astype(np.int32)
+        dst_part = np.sort(rng.integers(0, W, Vd)).astype(np.int32) if hetero else None
+        edges = np.stack([rng.integers(0, Vs, E), rng.integers(0, Vd, E)])
+        kw = dict(world_size=W, edge_owner=edge_owner, pad_multiple=8)
+        plan_np, layout_np = build_edge_plan(
+            edges, src_part, dst_part, use_native=False, **kw
+        )
+        plan_nat, layout_nat = build_edge_plan(
+            edges, src_part, dst_part, use_native=True, **kw
+        )
+        for field in (
+            "src_index", "dst_index", "edge_mask", "num_local_src",
+            "num_local_dst", "num_edges",
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(plan_np, field)),
+                np.asarray(getattr(plan_nat, field)), err_msg=field,
+            )
+        np.testing.assert_array_equal(plan_np.halo.send_idx, plan_nat.halo.send_idx)
+        np.testing.assert_array_equal(plan_np.halo.send_mask, plan_nat.halo.send_mask)
+        assert plan_np.halo.s_pad == plan_nat.halo.s_pad
+        assert plan_np.e_pad == plan_nat.e_pad
+        assert plan_np.halo_deltas == plan_nat.halo_deltas
+        assert plan_np.scatter_mc == plan_nat.scatter_mc
+        np.testing.assert_array_equal(layout_np.edge_rank, layout_nat.edge_rank)
+        np.testing.assert_array_equal(layout_np.edge_slot, layout_nat.edge_slot)
+        np.testing.assert_array_equal(layout_np.halo_counts, layout_nat.halo_counts)
