@@ -443,9 +443,10 @@ void* plan_core_begin(const int64_t* src, const int64_t* dst, int64_t E,
                       const int64_t* src_offsets, const int64_t* dst_offsets,
                       int64_t v_src, int64_t v_dst, int32_t W,
                       int32_t edge_owner_dst, int64_t* out_sizes) {
-  // edge ids travel as uint32 through the radix sorts; past 2^32 edges
-  // they would wrap and silently corrupt the plan — refuse instead
-  if (E >= (int64_t(1) << 32)) return nullptr;
+  // edge ids, per-rank slots, and pair ids are all stored in 32-bit
+  // fields; the signed ones (edge_slot, edge_pair) wrap at 2^31 — refuse
+  // anything that could overflow instead of silently corrupting the plan
+  if (E >= (int64_t(1) << 31)) return nullptr;
   auto* ctx = new PlanCtx();
   ctx->E = E;
   ctx->W = W;
@@ -492,23 +493,21 @@ void* plan_core_begin(const int64_t* src, const int64_t* dst, int64_t E,
     }
   }
 
-  // 3. cross-pair dedup with slot propagation
-  int64_t n_cross = 0;
+  // 3. cross-pair dedup with slot propagation; bucket by needer (= owner)
+  // first so the per-bucket radix ping-pong buffers are ~1/W of n_cross
+  // (a full-width sort's transient is ~24 bytes/cross-edge — tens of GB
+  // at papers100M scale)
+  std::vector<int64_t> nc_counts(W, 0);
   for (int64_t e = 0; e < E; ++e)
-    if (halo_part[halo_vid[e]] != ctx->owner[e]) ++n_cross;
+    if (halo_part[halo_vid[e]] != ctx->owner[e]) ++nc_counts[ctx->owner[e]];
+  std::vector<int64_t> nc_start(W + 1, 0);
+  for (int32_t r = 0; r < W; ++r) nc_start[r + 1] = nc_start[r] + nc_counts[r];
+  const int64_t n_cross = nc_start[W];
   ctx->edge_pair.assign(E, -1);
   ctx->halo_counts.assign(static_cast<size_t>(W) * W, 0);
   int64_t v_halo = edge_owner_dst ? v_src : v_dst;
   const int64_t* halo_off = edge_owner_dst ? src_offsets : dst_offsets;
   if (n_cross > 0) {
-    // bucket by needer (= owner) first so the per-bucket radix ping-pong
-    // buffers are ~1/W of n_cross (a full-width sort's transient is ~24
-    // bytes/cross-edge — tens of GB at papers100M scale)
-    std::vector<int64_t> nc_counts(W, 0);
-    for (int64_t e = 0; e < E; ++e)
-      if (halo_part[halo_vid[e]] != ctx->owner[e]) ++nc_counts[ctx->owner[e]];
-    std::vector<int64_t> nc_start(W + 1, 0);
-    for (int32_t r = 0; r < W; ++r) nc_start[r + 1] = nc_start[r] + nc_counts[r];
     std::vector<uint64_t> keys(n_cross);
     std::vector<uint32_t> vals(n_cross);
     {

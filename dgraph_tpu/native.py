@@ -30,29 +30,29 @@ def _load():
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        lib = None
-        for attempt in ("load", "rebuild"):
-            if attempt == "rebuild" or not os.path.exists(_SO):
-                try:
-                    subprocess.run(
-                        ["make", "-C", _CSRC] + (["-B"] if attempt == "rebuild" else []),
-                        check=True,
-                        capture_output=True,
-                        timeout=120,
-                    )
-                except Exception:
-                    _build_failed = True
-                    return None
+        # Rebuild BEFORE the first dlopen when the .so is missing or older
+        # than its source: once a stale library is CDLL'd, re-dlopening the
+        # same path returns the already-loaded handle (ctypes never
+        # dlcloses), so probe-then-rebuild cannot recover in-process.
+        src = os.path.join(_CSRC, "dgraph_host.cpp")
+        stale = not os.path.exists(_SO) or (
+            os.path.exists(src) and os.path.getmtime(_SO) < os.path.getmtime(src)
+        )
+        if stale:
             try:
-                candidate = ctypes.CDLL(_SO)
-                # a stale prebuilt .so can load but miss newer symbols —
-                # probe one recent entry point before binding signatures
-                candidate.plan_core_begin
-                lib = candidate
-                break
-            except (OSError, AttributeError):
-                continue
-        if lib is None:
+                subprocess.run(
+                    ["make", "-B", "-C", _CSRC],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.plan_core_begin  # newest entry point; missing = stale build
+        except (OSError, AttributeError):
             _build_failed = True
             return None
         i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
@@ -177,7 +177,11 @@ class PlanCore:
             len(src_part), len(dst_part),
             world_size, 1 if edge_owner == "dst" else 0, sizes,
         )
-        assert self._ctx, "plan_core_begin failed"
+        if not self._ctx:  # not an assert: must survive python -O
+            raise ValueError(
+                f"plan_core_begin refused E={len(self._src)} (int32 edge/pair "
+                "ids bound the native core at 2^31 edges)"
+            )
         self.e_max, self.s_max, self.num_pairs, self.num_cross = (
             int(sizes[0]), int(sizes[1]), int(sizes[2]), int(sizes[3]))
 

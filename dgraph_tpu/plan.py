@@ -561,16 +561,14 @@ def build_edge_plan(
             n_src_pad, n_dst_pad, e_pad, s_pad, pad_multiple,
         )
 
-    if edge_owner == "dst":
+    if edge_owner == "dst":  # validated above, before the native dispatch
         owner = dst_partition[dst]
         halo_side = "src"
         halo_vid, halo_part = src, src_partition
-    elif edge_owner == "src":
+    else:
         owner = src_partition[src]
         halo_side = "dst"
         halo_vid, halo_part = dst, dst_partition
-    else:
-        raise ValueError("edge_owner must be 'src' or 'dst'")
 
     # --- group edges by owner rank; optionally sort by owner-side vertex
     # within each rank so aggregation segment ids are monotone ---

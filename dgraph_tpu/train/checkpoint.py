@@ -78,11 +78,20 @@ def restore_checkpoint(ckpt_dir: str, template: dict, step: Optional[int] = None
 PLAN_FORMAT_VERSION = 3  # v3: scatter_block_e default 512 -> 1024
 
 
+def _hash_array(h, arr: np.ndarray) -> None:
+    # memoryview feeds hashlib without a copy; .tobytes() would materialize
+    # the whole array again (26 GB for a papers100M edge list)
+    arr = np.ascontiguousarray(arr)
+    h.update(str(arr.dtype).encode())
+    h.update(str(arr.shape).encode())
+    h.update(memoryview(arr).cast("B"))
+
+
 def _graph_fingerprint(edge_index: np.ndarray, partition: np.ndarray, **kw) -> str:
     h = hashlib.sha256()
     h.update(f"plan-format-v{PLAN_FORMAT_VERSION};".encode())
-    h.update(np.ascontiguousarray(edge_index).tobytes())
-    h.update(np.ascontiguousarray(partition).tobytes())
+    _hash_array(h, edge_index)
+    _hash_array(h, partition)
     h.update(repr(sorted(kw.items())).encode())
     return h.hexdigest()[:24]
 

@@ -147,3 +147,51 @@ def test_lead_first_follower_timeout(tmp_path):
             str(tmp_path / "never.bin"), lambda p: None, is_lead=False,
             poll_s=0.01, timeout_s=0.05,
         )
+
+
+def test_arxiv_shaped_export_roundtrip(tmp_path):
+    """The full export -> from_npz -> DistributedGraph -> train -> accuracy
+    loop (VERDICT r1 #5): arxiv-shaped stand-in, real learning measured on
+    the held-out split. The real ogbn-arxiv export produces the identical
+    format, so this pins every consumer the real arrays will flow through."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dgraph_tpu.comm import Communicator, make_graph_mesh
+    from dgraph_tpu.data import DistributedGraph
+    from dgraph_tpu.data.ogbn import export_arxiv_shaped_npz, from_npz
+    from dgraph_tpu.models import GCN
+    from dgraph_tpu.train.loop import init_params, make_eval_step, make_train_step
+
+    path = export_arxiv_shaped_npz(str(tmp_path / "arxiv_shaped.npz"), scale=0.01)
+    z = from_npz(path)
+    assert z["features"].shape[1] == 128 and z["features"].dtype == np.float32
+    assert int(np.asarray(z["labels"]).max()) + 1 == 40
+    # split proportions follow the real arxiv split
+    V = z["num_nodes"]
+    assert abs(z["train_mask"].sum() / V - 90_941 / 169_343) < 0.01
+
+    W = 4
+    g = DistributedGraph.from_global(
+        np.asarray(z["edge_index"]), np.asarray(z["features"]),
+        np.asarray(z["labels"]),
+        {"train": z["train_mask"], "val": z["valid_mask"], "test": z["test_mask"]},
+        world_size=W, partition_method="random", add_symmetric_norm=True,
+    )
+    mesh = make_graph_mesh(ranks_per_graph=W, devices=jax.devices()[:W])
+    comm = Communicator.init_process_group("tpu", world_size=W)
+    model = GCN(32, 40, comm=comm, num_layers=2)
+    plan = jax.tree.map(jnp.asarray, g.plan)
+    batch_tr = jax.tree.map(jnp.asarray, dict(g.batch("train"), y=g.labels))
+    batch_te = jax.tree.map(jnp.asarray, dict(g.batch("test"), y=g.labels))
+    params = init_params(model, mesh, plan, batch_tr)
+    opt = optax.adam(5e-3)
+    opt_state = opt.init(params)
+    step = make_train_step(model, opt, mesh, plan, donate=False)
+    eval_step = make_eval_step(model, mesh)
+    with jax.set_mesh(mesh):
+        for _ in range(25):
+            params, opt_state, _ = step(params, opt_state, batch_tr, plan)
+        acc = float(eval_step(params, batch_te, plan)["accuracy"])
+    assert acc > 0.3, f"held-out accuracy {acc} not above 40-class chance"
