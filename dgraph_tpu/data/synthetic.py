@@ -37,12 +37,24 @@ def sbm_classification_graph(
     feats = centroids[labels] + rng.normal(0, 2.0, (num_nodes, feat_dim))
 
     E = int(num_nodes * avg_degree // 2)
-    src = rng.integers(0, num_nodes, E * 3)
-    dst = rng.integers(0, num_nodes, E * 3)
-    same = labels[src] == labels[dst]
-    keep = np.where(same, rng.random(E * 3) < homophily, rng.random(E * 3) < (1 - homophily))
-    keep &= src != dst
-    src, dst = src[keep][:E], dst[keep][:E]
+    # rejection sampling with the ANALYTIC acceptance rate: p(keep) =
+    # homophily/num_classes + (1-homophily)(1-1/num_classes); a fixed 3x
+    # oversample silently underfills the quota at high class counts
+    # (num_classes=40, homophily=0.8 -> ~0.215 keep rate, ~35% short)
+    p_keep = homophily / num_classes + (1 - homophily) * (1 - 1 / num_classes)
+    src_parts, dst_parts, have = [], [], 0
+    while have < E:
+        n_draw = int((E - have) / max(p_keep, 1e-6) * 1.2) + 1024
+        s = rng.integers(0, num_nodes, n_draw)
+        d = rng.integers(0, num_nodes, n_draw)
+        same = labels[s] == labels[d]
+        keep = np.where(same, rng.random(n_draw) < homophily, rng.random(n_draw) < (1 - homophily))
+        keep &= s != d
+        src_parts.append(s[keep])
+        dst_parts.append(d[keep])
+        have += int(keep.sum())
+    src = np.concatenate(src_parts)[:E]
+    dst = np.concatenate(dst_parts)[:E]
     # symmetrize (the reference's OGB preprocessing does the same for arxiv)
     edge_index = np.stack(
         [np.concatenate([src, dst]), np.concatenate([dst, src])]

@@ -49,10 +49,27 @@ def _load():
             except Exception:
                 _build_failed = True
                 return None
+        lib = None
         try:
             lib = ctypes.CDLL(_SO)
             lib.plan_core_begin  # newest entry point; missing = stale build
-        except (OSError, AttributeError):
+        except OSError:
+            # a corrupt/truncated .so (interrupted link) fails CDLL outright
+            # — no handle was cached, so ONE rebuild-and-retry is safe
+            # (unlike the symbol-missing case, where the stale handle would
+            # be returned by any further dlopen of the same path)
+            try:
+                subprocess.run(
+                    ["make", "-B", "-C", _CSRC],
+                    check=True, capture_output=True, timeout=120,
+                )
+                lib = ctypes.CDLL(_SO)
+                lib.plan_core_begin
+            except Exception:
+                lib = None
+        except AttributeError:
+            lib = None
+        if lib is None:
             _build_failed = True
             return None
         i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")

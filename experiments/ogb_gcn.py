@@ -53,6 +53,15 @@ def _num_classes(labels: np.ndarray) -> int:
     return int(labels.max()) + 1
 
 
+def _normalize_split_names(masks: dict) -> dict:
+    """OGB says "valid"; the training loop's split name is "val"
+    (DistributedGraph.batch falls back to ALL vertices on an unknown split
+    — a silent eval-on-everything without this rename)."""
+    if "valid" in masks and "val" not in masks:
+        masks["val"] = masks.pop("valid")
+    return masks
+
+
 def load_data(cfg: DataConfig):
     if cfg.ogb_name:
         from dgraph_tpu.data import ogbn
@@ -62,27 +71,23 @@ def load_data(cfg: DataConfig):
             else ogbn.load_ogb_arrays(cfg.ogb_name)
         )
         labels = np.asarray(arrs["labels"])
+        masks = {
+            k.removesuffix("_mask"): np.asarray(v)
+            for k, v in arrs.items()
+            if k.endswith("_mask")
+        }
         return {
             "edge_index": np.asarray(arrs["edge_index"]),
             "features": np.asarray(arrs["features"]),
             "labels": labels,
-            "masks": {
-                k.removesuffix("_mask"): np.asarray(v)
-                for k, v in arrs.items()
-                if k.endswith("_mask")
-            },
+            "masks": _normalize_split_names(masks),
             "num_classes": _num_classes(labels),
         }
     if cfg.path:
         z = np.load(cfg.path)
-        masks = {
+        masks = _normalize_split_names({
             k.removesuffix("_mask"): z[k] for k in z.files if k.endswith("_mask")
-        }
-        # OGB exports say "valid"; the training loop's split name is "val"
-        # (DistributedGraph.batch falls back to ALL vertices on an unknown
-        # split — a silent eval-on-everything without this rename)
-        if "valid" in masks and "val" not in masks:
-            masks["val"] = masks.pop("valid")
+        })
         return {
             "edge_index": z["edge_index"],
             "features": z["features"],

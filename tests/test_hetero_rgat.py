@@ -160,3 +160,30 @@ def test_rgat_trains(mesh8, mag):
             params, opt_state, loss = step(params, opt_state)
             losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_locality_partition_cuts_halo_volume():
+    """Union-graph locality partitioning must reduce total deduped halo
+    pairs vs random (VERDICT r1 #7: random hetero partition makes RGAT halo
+    volume worst-case by construction) while keeping every type's per-rank
+    balance within the padding slack."""
+    from dgraph_tpu.data.hetero import DistributedHeteroGraph, synthetic_mag
+
+    W = 4
+    nf, rels, labels, masks = synthetic_mag(2000, 1200, 120, 8, 4, seed=2)
+
+    def halo_pairs(g):
+        return sum(int(l.halo_counts.sum()) for l in g.layouts.values())
+
+    g_rand = DistributedHeteroGraph.from_global(
+        nf, rels, W, labels=labels, masks=masks, partition_method="random"
+    )
+    g_loc = DistributedHeteroGraph.from_global(
+        nf, rels, W, labels=labels, masks=masks, partition_method="multilevel"
+    )
+    hp_rand, hp_loc = halo_pairs(g_rand), halo_pairs(g_loc)
+    assert hp_loc < 0.8 * hp_rand, (hp_loc, hp_rand)
+    # per-type balance: padded size within slack of the ideal share
+    for t, ren in g_loc.renumberings.items():
+        V = len(ren.perm)
+        assert ren.counts.max() <= int(np.ceil(V / W * 1.05)) + 1, (t, ren.counts)
