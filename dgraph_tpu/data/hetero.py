@@ -163,10 +163,16 @@ class DistributedHeteroGraph:
                 part = pt.random_partition(V, world_size, seed)
             rens[t] = pt.renumber_contiguous(part, world_size)
             n_pads[t] = _pad_to(int(rens[t].counts.max(initial=1)), pad_multiple)
-            feats[t] = shard_vertex_data(
-                np.asarray(node_features[t], np.float32)[rens[t].inv],
-                rens[t].counts,
-                n_pads[t],
+            # shard_rows reads each shard's rows page-sequentially — a
+            # memmap source (MAG240M fp16 features, 187 GB at full scale)
+            # is never materialized whole, unlike
+            # np.asarray(...)[inv] which would copy it twice
+            from dgraph_tpu.data.memmap import shard_rows
+
+            feats[t] = shard_rows(
+                node_features[t], rens[t].inv,
+                np.concatenate([[0], np.cumsum(rens[t].counts)]),
+                n_pads[t], range(world_size), np.float32,
             )
 
         plans, layouts = {}, {}

@@ -17,6 +17,9 @@ import time
 class Config:
     """RGAT paper-classification training."""
 
+    # MAG240M memmap directory (prepare_mag240m_memmap /
+    # synthetic_mag240m_memmap layout); overrides the in-memory generator
+    memmap_dir: str = ""
     num_papers: int = 5000
     num_authors: int = 3000
     num_institutions: int = 300
@@ -54,9 +57,17 @@ def main(cfg: Config):
 
     from dgraph_tpu.plan import plan_efficiency
 
-    nf, rels, labels, masks = synthetic_mag(
-        cfg.num_papers, cfg.num_authors, cfg.num_institutions, cfg.feat_dim, cfg.num_classes
-    )
+    if cfg.memmap_dir:
+        from dgraph_tpu.data.mag240m import load_mag240m_memmap
+
+        nf, rels, labels, masks, meta = load_mag240m_memmap(cfg.memmap_dir)
+        num_classes = meta["num_classes"]
+    else:
+        nf, rels, labels, masks = synthetic_mag(
+            cfg.num_papers, cfg.num_authors, cfg.num_institutions,
+            cfg.feat_dim, cfg.num_classes,
+        )
+        num_classes = cfg.num_classes
     t0 = time.perf_counter()
     g = DistributedHeteroGraph.from_global(
         nf, rels, world, labels=labels, masks=masks,
@@ -79,7 +90,7 @@ def main(cfg: Config):
 
     model = RGAT(
         hidden_features=cfg.hidden,
-        out_features=cfg.num_classes,
+        out_features=num_classes,
         comm=comm,
         relations=list(g.plans),
         num_layers=cfg.num_layers,
