@@ -71,6 +71,28 @@ class _BaseComm:
 
     scatter_sum = scatter
 
+    def put(self, send: jax.Array) -> jax.Array:
+        """Deliver per-peer blocks by offsets — the ``BackendEngine.put``
+        contract (``Engine.py:67-86``): two-sided backends alltoallv the
+        blocks; one-sided backends write them at precomputed remote
+        offsets. On TPU both collapse to ONE ``lax.all_to_all`` whose
+        received blocks land in sender-rank order — exactly the
+        ``CommPattern.put_forward_remote_offset`` positions (the plan's
+        halo-slot numbering), so no receive-placement pass exists.
+
+        Args:
+          send: [W, S, F] — block ``send[p]`` goes to peer p (pad to the
+            common S; mask padding upstream).
+        Returns: [W*S, F]; rows [p*S, (p+1)*S) hold peer p's block.
+        """
+        W, S, F = send.shape
+        if self.graph_axis is None:
+            if W != 1:
+                raise ValueError("put with world_size 1 expects send.shape[0] == 1")
+            return send.reshape(S, F)
+        recv = lax.all_to_all(send, self.graph_axis, split_axis=0, concat_axis=0)
+        return recv.reshape(W * S, F)
+
     # -- reductions over mesh axes --
     def all_reduce_sum(self, x):
         if self.graph_axis is None:

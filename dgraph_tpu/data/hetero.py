@@ -170,8 +170,7 @@ class DistributedHeteroGraph:
             from dgraph_tpu.data.memmap import shard_rows
 
             feats[t] = shard_rows(
-                node_features[t], rens[t].inv,
-                np.concatenate([[0], np.cumsum(rens[t].counts)]),
+                node_features[t], rens[t].inv, rens[t].offsets,
                 n_pads[t], range(world_size), np.float32,
             )
 
