@@ -22,8 +22,30 @@ def _env_flag(name: str, default: bool = False) -> bool:
 use_pallas_scatter: bool = _env_flag("DGRAPH_TPU_PALLAS_SCATTER", False)
 
 # Compute dtype for model matmuls (bfloat16 keeps the MXU fed; params stay
-# float32). Models read this at construction time.
+# float32). Models resolve dtype=None through resolve_compute_dtype(), so
+# DGRAPH_TPU_COMPUTE_DTYPE=bfloat16 flips every model at once.
 default_compute_dtype: str = os.environ.get("DGRAPH_TPU_COMPUTE_DTYPE", "float32")
+
+
+def resolve_compute_dtype(dtype):
+    """None -> the configured default ('float32' stays None: flax Dense's
+    native f32 path); an explicit dtype wins. Unknown config strings raise
+    (a typo like 'bf16' silently training in f32 would misattribute every
+    benchmark)."""
+    if dtype is not None:
+        return dtype
+    name = default_compute_dtype
+    if name in ("float32", "f32"):
+        return None
+    import jax.numpy as jnp
+
+    table = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16, "float16": jnp.float16}
+    if name not in table:
+        raise ValueError(
+            f"DGRAPH_TPU_COMPUTE_DTYPE={name!r} not understood; expected "
+            "float32, bfloat16, or float16"
+        )
+    return table[name]
 
 # Column-chunk width for row gathers (ops.local.row_take). XLA's TPU
 # row-gather fast path covers one 128-lane tile; wider rows are gathered

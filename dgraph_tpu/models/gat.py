@@ -27,6 +27,7 @@ class GATConv(nn.Module):
     num_heads: int = 1
     negative_slope: float = 0.2
     residual: bool = False
+    dtype: Any = None  # None -> config.default_compute_dtype
 
     @nn.compact
     def __call__(self, x: jax.Array, plan: EdgePlan) -> jax.Array:
@@ -36,8 +37,11 @@ class GATConv(nn.Module):
                 "attention softmax is rank-local; build the plan with "
                 "edge_owner='dst'"
             )
+        from dgraph_tpu import config as _cfg
+
+        dt = _cfg.resolve_compute_dtype(self.dtype)
         H, D = self.num_heads, self.out_features
-        w = nn.Dense(H * D, use_bias=False, name="proj")
+        w = nn.Dense(H * D, use_bias=False, name="proj", dtype=dt)
         hx = w(x).reshape(-1, H, D)  # [n_pad, H, D]
 
         # per-edge endpoint features: src via halo gather, dst local
@@ -50,6 +54,11 @@ class GATConv(nn.Module):
 
         a_src = self.param("att_src", nn.initializers.glorot_uniform(), (H, D))
         a_dst = self.param("att_dst", nn.initializers.glorot_uniform(), (H, D))
+        # cast params to the compute dtype: f32 attention params would
+        # promote the [e_pad, H, D] tensors (the HBM-dominant ones) back
+        # to f32 and forfeit the bf16 bandwidth win
+        a_src = a_src.astype(h_src.dtype)
+        a_dst = a_dst.astype(h_dst.dtype)
         logits = (h_src * a_src).sum(-1) + (h_dst * a_dst).sum(-1)  # [e_pad, H]
         logits = nn.leaky_relu(logits, self.negative_slope)
 
@@ -61,7 +70,7 @@ class GATConv(nn.Module):
         out = self.comm.scatter_sum(msg, plan, side="dst").reshape(-1, H, D)
         out = out.mean(axis=1)  # head-mean (reference RGAT uses concat+proj; mean keeps D)
         if self.residual:
-            out = out + nn.Dense(D, use_bias=False, name="res")(x)
+            out = out + nn.Dense(D, use_bias=False, name="res", dtype=dt)(x)
         return out
 
 
@@ -71,12 +80,19 @@ class GAT(nn.Module):
     comm: Any
     num_layers: int = 2
     num_heads: int = 4
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, plan: EdgePlan) -> jax.Array:
+        from dgraph_tpu import config as _cfg
+
+        # children resolve None themselves; only the head Dense needs the
+        # concrete dtype here
         for _ in range(self.num_layers):
-            x = GATConv(self.hidden_features, comm=self.comm, num_heads=self.num_heads)(
-                x, plan
-            )
+            x = GATConv(
+                self.hidden_features, comm=self.comm, num_heads=self.num_heads,
+                dtype=self.dtype,
+            )(x, plan)
             x = nn.elu(x)
-        return nn.Dense(self.out_features)(x)
+        head_dt = _cfg.resolve_compute_dtype(self.dtype)
+        return nn.Dense(self.out_features, dtype=head_dt)(x).astype(jnp.float32)

@@ -51,8 +51,11 @@ class GraphConvLayer(nn.Module):
         # and gather the projected D-dim rows — instead of materializing the
         # [E, 2F] concat the reference builds per edge (GCN.py:34-67). Saves
         # ~(E/N)x matmul FLOPs and the [E,2F] HBM round trip; exact same math.
-        h_s = nn.Dense(self.out_features, name="src_proj", dtype=self.dtype)(x)
-        h_d = nn.Dense(self.out_features, use_bias=False, name="dst_proj", dtype=self.dtype)(x)
+        from dgraph_tpu import config as _cfg
+
+        dt = _cfg.resolve_compute_dtype(self.dtype)
+        h_s = nn.Dense(self.out_features, name="src_proj", dtype=dt)(x)
+        h_d = nn.Dense(self.out_features, use_bias=False, name="dst_proj", dtype=dt)(x)
         m = self.comm.gather(h_s, plan, side="src") + self.comm.gather(
             h_d, plan, side="dst"
         )
@@ -81,6 +84,8 @@ class GCN(nn.Module):
         edge_weight: Optional[jax.Array] = None,
         deterministic: bool = True,
     ) -> jax.Array:
+        from dgraph_tpu import config as _cfg
+
         for _ in range(self.num_layers):
             x = GraphConvLayer(
                 self.hidden_features,
@@ -90,4 +95,5 @@ class GCN(nn.Module):
             )(x, plan, edge_weight)
             if self.dropout_rate > 0:
                 x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
-        return nn.Dense(self.out_features, dtype=self.dtype)(x).astype(jnp.float32)
+        head_dt = _cfg.resolve_compute_dtype(self.dtype)
+        return nn.Dense(self.out_features, dtype=head_dt)(x).astype(jnp.float32)

@@ -18,10 +18,13 @@ class MLP(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        from dgraph_tpu import config as _cfg
+
+        dtype = _cfg.resolve_compute_dtype(self.dtype)
         for i, f in enumerate(self.features):
-            x = nn.Dense(f, dtype=self.dtype)(x)
+            x = nn.Dense(f, dtype=dtype)(x)
             if i < len(self.features) - 1:
                 x = self.activation(x)
         if self.use_layer_norm:
-            x = nn.LayerNorm(dtype=self.dtype)(x)
+            x = nn.LayerNorm(dtype=dtype)(x)
         return x

@@ -32,16 +32,25 @@ class RelationalAttention(nn.Module):
     comm: Any
     num_heads: int = 2
     negative_slope: float = 0.2
+    dtype: Any = None  # None -> config.default_compute_dtype
 
     @nn.compact
     def __call__(self, x_src: jax.Array, x_dst: jax.Array, plan) -> jax.Array:
+        from dgraph_tpu import config as _cfg
+
+        dt = _cfg.resolve_compute_dtype(self.dtype)
         H, D = self.num_heads, self.out_features
-        hs = nn.Dense(H * D, use_bias=False, name="src_proj")(x_src)
-        hd = nn.Dense(H * D, use_bias=False, name="dst_proj")(x_dst)
+        hs = nn.Dense(H * D, use_bias=False, name="src_proj", dtype=dt)(x_src)
+        hd = nn.Dense(H * D, use_bias=False, name="dst_proj", dtype=dt)(x_dst)
         h_src = self.comm.gather(hs, plan, side="src").reshape(-1, H, D)
         h_dst = self.comm.gather(hd, plan, side="dst").reshape(-1, H, D)
         a_src = self.param("att_src", nn.initializers.glorot_uniform(), (H, D))
         a_dst = self.param("att_dst", nn.initializers.glorot_uniform(), (H, D))
+        # cast params to the compute dtype: f32 attention params would
+        # promote the [e_pad, H, D] tensors (the HBM-dominant ones) back
+        # to f32 and forfeit the bf16 bandwidth win
+        a_src = a_src.astype(h_src.dtype)
+        a_dst = a_dst.astype(h_dst.dtype)
         logits = (h_src * a_src).sum(-1) + (h_dst * a_dst).sum(-1)
         logits = nn.leaky_relu(logits, self.negative_slope)
         alpha = local_ops.segment_softmax(
@@ -62,11 +71,16 @@ class RGATLayer(nn.Module):
     relations: Sequence[tuple]  # RelKeys
     num_heads: int = 2
     use_batch_norm: bool = True
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, feats: dict, plans: dict, vertex_masks: dict, train: bool = False):
+        from dgraph_tpu import config as _cfg
+
+        cdt = _cfg.resolve_compute_dtype(self.dtype)  # for this layer's Denses
         agg = {
-            t: nn.Dense(self.out_features, name=f"self_{t}")(x) for t, x in feats.items()
+            t: nn.Dense(self.out_features, name=f"self_{t}", dtype=cdt)(x)
+            for t, x in feats.items()
         }
         for key in self.relations:
             st, name, dt = key
@@ -74,6 +88,7 @@ class RGATLayer(nn.Module):
                 self.out_features,
                 comm=self.comm,
                 num_heads=self.num_heads,
+                dtype=self.dtype,
                 name=f"rel_{st}_{name}_{dt}",
             )(feats[st], feats[dt], plans[key])
             agg[dt] = agg[dt] + msg
@@ -100,9 +115,12 @@ class RGAT(nn.Module):
     num_layers: int = 2
     num_heads: int = 2
     use_batch_norm: bool = True
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, feats: dict, plans: dict, vertex_masks: dict, train: bool = False):
+        from dgraph_tpu import config as _cfg
+
         h = feats
         for i in range(self.num_layers):
             h = RGATLayer(
@@ -111,6 +129,10 @@ class RGAT(nn.Module):
                 relations=tuple(self.relations),
                 num_heads=self.num_heads,
                 use_batch_norm=self.use_batch_norm,
+                dtype=self.dtype,
                 name=f"layer_{i}",
             )(h, plans, vertex_masks, train)
-        return nn.Dense(self.out_features, name="head")(h[self.target_type])
+        head_dt = _cfg.resolve_compute_dtype(self.dtype)
+        return nn.Dense(self.out_features, name="head", dtype=head_dt)(
+            h[self.target_type]
+        ).astype(jnp.float32)

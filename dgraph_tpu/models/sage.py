@@ -21,17 +21,21 @@ class SAGEConv(nn.Module):
     out_features: int
     comm: Any
     activation: Any = nn.relu
+    dtype: Any = None  # None -> config.default_compute_dtype
 
     @nn.compact
     def __call__(self, x: jax.Array, plan: EdgePlan) -> jax.Array:
+        from dgraph_tpu import config as _cfg
+
+        dt = _cfg.resolve_compute_dtype(self.dtype)
         h_src = self.comm.gather(x, plan, side="src")  # [e_pad, F]
         agg = self.comm.scatter_sum(h_src, plan, side="dst")  # [n_pad, F]
         ones = plan.edge_mask[:, None]
         deg = self.comm.scatter_sum(ones, plan, side="dst")  # [n_pad, 1]
         mean_nbr = agg / jnp.maximum(deg, 1.0)
-        out = nn.Dense(self.out_features)(x) + nn.Dense(self.out_features, use_bias=False)(
-            mean_nbr
-        )
+        out = nn.Dense(self.out_features, dtype=dt)(x) + nn.Dense(
+            self.out_features, use_bias=False, dtype=dt
+        )(mean_nbr)
         return self.activation(out)
 
 
@@ -40,9 +44,13 @@ class GraphSAGE(nn.Module):
     out_features: int
     comm: Any
     num_layers: int = 2
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, plan: EdgePlan) -> jax.Array:
+        from dgraph_tpu import config as _cfg
+
         for _ in range(self.num_layers):
-            x = SAGEConv(self.hidden_features, comm=self.comm)(x, plan)
-        return nn.Dense(self.out_features)(x)
+            x = SAGEConv(self.hidden_features, comm=self.comm, dtype=self.dtype)(x, plan)
+        head_dt = _cfg.resolve_compute_dtype(self.dtype)
+        return nn.Dense(self.out_features, dtype=head_dt)(x).astype(jnp.float32)
