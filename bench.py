@@ -319,8 +319,29 @@ def bench_graphcast(dtype_name: str):
     return ms, {"level": level, "latent": latent, "layers": layers}
 
 
+def _arm_watchdog():
+    """A wedged tunnel lease hangs ANY device op indefinitely (observed
+    r1+r2); fail loudly with a JSON line instead of hanging the driver."""
+    import signal
+
+    budget = int(os.environ.get("DGRAPH_BENCH_TIMEOUT", "2400"))
+
+    def _bail(signum, frame):
+        print(json.dumps({
+            "metric": "arxiv_gcn_epoch_time", "value": None, "unit": "ms",
+            "vs_baseline": None,
+            "error": f"watchdog: no result within {budget}s (wedged TPU lease?)",
+        }))
+        sys.stdout.flush()
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, _bail)
+    signal.alarm(budget)
+
+
 def main():
     t_start = time.time()
+    _arm_watchdog()
     log("importing jax...")
     import jax
 
