@@ -188,7 +188,7 @@ def gather(
 
     hints = (
         (plan.scatter_block_e, plan.scatter_block_n, plan.scatter_mc)
-        if (sorted_ids and _cfg.use_pallas_scatter)
+        if (sorted_ids and _cfg.pallas_scatter_enabled())
         else None
     )
     taken = local_ops.take_rows(
@@ -224,7 +224,7 @@ def scatter_sum(
         from dgraph_tpu import config as _cfg
 
         if (
-            _cfg.use_pallas_scatter
+            _cfg.pallas_scatter_enabled()
             and plan.owner_sorted
             and jax.default_backend() == "tpu"
         ):

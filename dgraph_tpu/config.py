@@ -10,7 +10,7 @@ from __future__ import annotations
 import os
 
 
-def _env_flag(name: str, default: bool = False) -> bool:
+def _env_flag(name: str, default: bool | None = False) -> bool | None:
     v = os.environ.get(name)
     if v is None:
         return default
@@ -19,7 +19,18 @@ def _env_flag(name: str, default: bool = False) -> bool:
 
 # Use the Pallas sorted-segment-sum kernel for owner-side scatter on TPU
 # (requires plan.owner_sorted; falls back to jnp segment_sum elsewhere).
-use_pallas_scatter: bool = _env_flag("DGRAPH_TPU_PALLAS_SCATTER", False)
+# Tri-state: None = auto (ON when the default backend is TPU — e2e A/B'd on
+# v5e, logs/pallas_ab_r2.jsonl); env DGRAPH_TPU_PALLAS_SCATTER=0/1 pins it.
+use_pallas_scatter: bool | None = _env_flag("DGRAPH_TPU_PALLAS_SCATTER", None)
+
+
+def pallas_scatter_enabled() -> bool:
+    """Resolve the tri-state ``use_pallas_scatter`` (None = TPU backend)."""
+    if use_pallas_scatter is not None:
+        return use_pallas_scatter
+    import jax
+
+    return jax.default_backend() == "tpu"
 
 # Compute dtype for model matmuls (bfloat16 keeps the MXU fed; params stay
 # float32). Models resolve dtype=None through resolve_compute_dtype(), so

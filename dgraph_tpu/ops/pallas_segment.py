@@ -134,15 +134,20 @@ def _sorted_segment_sum_impl(
         out_specs=pl.BlockSpec((block_n, F), lambda b, k, starts, counts: (b, 0)),
     )
     prec = jax.lax.Precision.HIGHEST if precision == "highest" else jax.lax.Precision.DEFAULT
+    # The MXU accumulator must be 32-bit ('tpu.matmul' rejects a bf16 acc),
+    # and f32 accumulation over long segments is the atomicAdd-parity
+    # semantics anyway — so the VMEM-resident output block is ALWAYS f32
+    # (bf16 inputs still ride the fast bf16 MXU passes under
+    # precision='default'); cast back to the input dtype on the way out.
     out = pl.pallas_call(
         functools.partial(
             _kernel, block_n=block_n, block_e=block_e, input_op=input_op, precision=prec
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((N_pad, F), data.dtype),
+        out_shape=jax.ShapeDtypeStruct((N_pad, F), jnp.float32),
         interpret=interpret,
     )(chunk_start, chunk_counts, ids3d, data3d)
-    return out[:num_segments]
+    return out[:num_segments].astype(data.dtype)
 
 
 @functools.lru_cache(maxsize=None)
