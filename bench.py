@@ -295,7 +295,25 @@ def bench_graphcast(dtype_name: str):
     y = jax.random.normal(jax.random.key(4), (n_grid, ch), jnp.float32)
     gmask = dev(graphs.grid_mask)
 
-    params = model.init(jax.random.key(5), x, statics, plans)
+    # init on a TINY level-1 graph: params depend only on feature dims
+    # (statics are 4-wide at every level), and an eager full-scale init
+    # materializes the level-6 forward's intermediates op-by-op — the OOM
+    # seen in the first r2 capture happened here, not in the step itself.
+    tiny = build_graphcast_graphs(1, 10, 18, 1)
+    t_statics = {
+        "grid_node_static": dev(tiny.grid_node_static),
+        "mesh_node_static": dev(tiny.mesh_node_static),
+        "mesh_edge_static": dev(tiny.mesh_edge_static),
+        "g2m_edge_static": dev(tiny.g2m_edge_static),
+        "m2g_edge_static": dev(tiny.m2g_edge_static),
+    }
+    t_plans = {
+        "mesh": jax.tree.map(dev, tiny.mesh_plan),
+        "g2m": jax.tree.map(dev, tiny.g2m_plan),
+        "m2g": jax.tree.map(dev, tiny.m2g_plan),
+    }
+    x_tiny = jnp.zeros((t_plans["g2m"].n_src_pad, ch), jnp.float32)
+    params = model.init(jax.random.key(5), x_tiny, t_statics, t_plans)
     opt = optax.adamw(1e-4, weight_decay=0.1)
     opt_state = opt.init(params)
     log("graphcast: params initialized; compiling step scan...")

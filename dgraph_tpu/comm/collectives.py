@@ -174,18 +174,29 @@ def gather(
       x: [n_pad, F] per-shard vertex features for that side's vertex set.
     Returns: [e_pad, F] per-edge features (masked edges are zero).
     """
+    from dgraph_tpu import config as _cfg
+
     idx = _side_index(plan, side)
     if side == plan.halo_side:
         haloed = halo_exchange(x, plan.halo, axis_name, deltas=plan.halo_deltas)
         full = jnp.concatenate([x, haloed], axis=0)
-        sorted_ids = False  # mixed local/halo-slot numbering
+        # halo-side ids are NOT monotone (local rows then halo slots); the
+        # plan's sorting permutation still gives the VJP a sorted
+        # segment-sum path (gather-by-perm first) when present
+        if plan.halo_sort_perm is not None:
+            taken = local_ops.take_rows_sort_route(
+                full, idx, plan.halo_sort_perm, plan.halo_sorted_ids,
+                pallas_hints=(
+                    plan.scatter_block_e, plan.scatter_block_n, plan.halo_sort_mc
+                ),
+            )
+            return taken * plan.edge_mask[:, None].astype(x.dtype)
+        sorted_ids = False
     else:
         full = x
         # owner-side ids are plan-sorted; route the VJP (a scatter-sum
         # transpose, _torch_func_impl.py:112-191) through the sorted path
         sorted_ids = plan.owner_sorted
-    from dgraph_tpu import config as _cfg
-
     hints = (
         (plan.scatter_block_e, plan.scatter_block_n, plan.scatter_mc)
         if (sorted_ids and _cfg.pallas_scatter_enabled())
@@ -220,29 +231,28 @@ def scatter_sum(
     idx = _side_index(plan, side)
     n_pad = _side_npad(plan, side)
     if side != plan.halo_side:
-        # owner-side aggregation: plan-sorted monotone segment ids
-        from dgraph_tpu import config as _cfg
-
-        if (
-            _cfg.pallas_scatter_enabled()
-            and plan.owner_sorted
-            and jax.default_backend() == "tpu"
-        ):
-            from dgraph_tpu.ops.pallas_segment import sorted_segment_sum
-
-            # bf16 activations already carry bf16 precision — take the fast
-            # single-pass MXU path; f32 gets faithful accumulation.
-            prec = "default" if edata.dtype == jnp.bfloat16 else "highest"
-            return sorted_segment_sum(
-                edata, idx, n_pad, max_chunks_per_block=plan.scatter_mc,
-                block_e=plan.scatter_block_e, block_n=plan.scatter_block_n,
-                precision=prec,
+        # owner-side aggregation: plan-sorted monotone segment ids ride the
+        # shared Pallas-or-jnp dispatch (kill switch + precision policy in
+        # ONE place: ops.local._sorted_segment_sum_any)
+        if plan.owner_sorted:
+            return local_ops._sorted_segment_sum_any(
+                edata, idx, n_pad, plan.scatter_block_e, plan.scatter_block_n,
+                plan.scatter_mc,
             )
-        return local_ops.segment_sum(
-            edata, idx, n_pad, indices_are_sorted=plan.owner_sorted
-        )
+        return local_ops.segment_sum(edata, idx, n_pad, indices_are_sorted=False)
     W = plan.world_size
-    full = local_ops.segment_sum(edata, idx, n_pad + W * plan.halo.s_pad)
+    n_full = n_pad + W * plan.halo.s_pad
+    if plan.halo_sort_perm is not None:
+        # unsorted halo-side ids, but the plan's sorting permutation turns
+        # the forward into gather-by-perm + sorted segment-sum (Pallas MXU)
+        full = local_ops.segment_sum_sort_route(
+            edata, idx, plan.halo_sort_perm, plan.halo_sorted_ids, n_full,
+            pallas_hints=(
+                plan.scatter_block_e, plan.scatter_block_n, plan.halo_sort_mc
+            ),
+        )
+    else:
+        full = local_ops.segment_sum(edata, idx, n_full)
     local_part = full[:n_pad]
     remote_part = full[n_pad:]
     return local_part + halo_scatter_sum(

@@ -88,39 +88,43 @@ class GraphCast(nn.Module):
     out_channels: int = 73
     comm: Any = None
     dtype: Any = None  # compute dtype (bfloat16 recommended on TPU)
-    remat: bool = True  # rematerialize processor blocks under AD: per-layer
-    # saved state drops to the two residual streams (e_mesh, m); trades
-    # ~2x processor recompute FLOPs for the memory that lets 16-layer
-    # level-6 training fit one chip (jax.checkpoint, SURVEY §5 memory knobs)
+    remat: bool = True  # rematerialize EVERY block under AD, not just the
+    # processor: at level-6 scale the encoder/decoder blocks and the edge
+    # embedders each hold several [3.11M, L] intermediates (1.6 GB apiece in
+    # bf16 at L=256) for the backward — without remat the decoder alone
+    # overflows a 16 GB chip. Saved state drops to the residual streams;
+    # trades ~2x recompute FLOPs for the memory that lets 16-layer level-6
+    # training fit one v5e (jax.checkpoint, SURVEY §5 memory knobs)
 
     @nn.compact
     def __call__(self, grid_feats, statics, plans):
         L = self.latent
+        EdgeB = nn.remat(MeshEdgeBlock) if self.remat else MeshEdgeBlock
+        NodeB = nn.remat(MeshNodeBlock) if self.remat else MeshNodeBlock
+        Emb = nn.remat(MLP) if self.remat else MLP
         # --- Embedder: 5 MLPs (model.py:79-105) ---
-        g = MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_grid")(
+        g = Emb([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_grid")(
             jnp.concatenate([grid_feats, statics["grid_node_static"]], axis=-1)
         )
-        m = MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_mesh")(
+        m = Emb([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_mesh")(
             statics["mesh_node_static"]
         )
-        e_mesh = MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_mesh_edges")(
+        e_mesh = Emb([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_mesh_edges")(
             statics["mesh_edge_static"]
         )
-        e_g2m = MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_g2m_edges")(
+        e_g2m = Emb([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_g2m_edges")(
             statics["g2m_edge_static"]
         )
-        e_m2g = MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_m2g_edges")(
+        e_m2g = Emb([L, L], use_layer_norm=True, dtype=self.dtype, name="embed_m2g_edges")(
             statics["m2g_edge_static"]
         )
 
         # --- Encoder: grid -> mesh (model.py:142-168) ---
-        e_g2m = MeshEdgeBlock(L, self.comm, dtype=self.dtype, name="enc_edge")(e_g2m, g, m, plans["g2m"])
-        m = MeshNodeBlock(L, self.comm, dtype=self.dtype, name="enc_node")(m, e_g2m, plans["g2m"])
-        g = g + MLP([L, L], use_layer_norm=True, dtype=self.dtype, name="enc_grid_mlp")(g)
+        e_g2m = EdgeB(L, self.comm, dtype=self.dtype, name="enc_edge")(e_g2m, g, m, plans["g2m"])
+        m = NodeB(L, self.comm, dtype=self.dtype, name="enc_node")(m, e_g2m, plans["g2m"])
+        g = g + Emb([L, L], use_layer_norm=True, dtype=self.dtype, name="enc_grid_mlp")(g)
 
         # --- Processor: multimesh message passing (model.py:208-230) ---
-        EdgeB = nn.remat(MeshEdgeBlock) if self.remat else MeshEdgeBlock
-        NodeB = nn.remat(MeshNodeBlock) if self.remat else MeshNodeBlock
         for i in range(self.processor_layers):
             e_mesh = EdgeB(L, self.comm, dtype=self.dtype, name=f"proc_edge_{i}")(
                 e_mesh, m, m, plans["mesh"]
@@ -130,8 +134,8 @@ class GraphCast(nn.Module):
             )
 
         # --- Decoder: mesh -> grid (model.py:268-308) ---
-        e_m2g = MeshEdgeBlock(L, self.comm, dtype=self.dtype, name="dec_edge")(e_m2g, m, g, plans["m2g"])
-        g = MeshNodeBlock(L, self.comm, dtype=self.dtype, name="dec_node")(g, e_m2g, plans["m2g"])
+        e_m2g = EdgeB(L, self.comm, dtype=self.dtype, name="dec_edge")(e_m2g, m, g, plans["m2g"])
+        g = NodeB(L, self.comm, dtype=self.dtype, name="dec_node")(g, e_m2g, plans["m2g"])
 
         # --- prediction head: residual over input channels (model.py:392-394) ---
         delta = MLP([L, self.out_channels], dtype=self.dtype, name="head")(g)

@@ -138,6 +138,103 @@ def take_rows(
     )(x, idx)
 
 
+def _sorted_segment_sum_any(data, sorted_ids, n_rows, be, bn, mc):
+    """Sorted segment-sum via the Pallas MXU kernel when it's enabled AND
+    the backend is TPU, jnp elsewhere. The single dispatch point for every
+    sorted reduction (owner-side scatter and the halo sort route) so the
+    kill switch (``config.use_pallas_scatter``, e.g. bench's failed
+    self-check fallback) and the precision policy cannot diverge between
+    call sites."""
+    from dgraph_tpu import config as _cfg
+
+    if _cfg.pallas_scatter_enabled() and jax.default_backend() == "tpu":
+        from dgraph_tpu.ops.pallas_segment import sorted_segment_sum
+
+        prec = "default" if data.dtype == jnp.bfloat16 else "highest"
+        return sorted_segment_sum(
+            data, sorted_ids, n_rows, max_chunks_per_block=mc,
+            block_e=be, block_n=bn, precision=prec,
+        )
+    # fallback keeps the col-split-take VJP pinning (segment_sum wrapper),
+    # not jax.ops.segment_sum's plain wide-gather transpose
+    return segment_sum(data, sorted_ids, n_rows, indices_are_sorted=True)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_take_rows_sortroute(n_rows, col_block, be, bn, mc):
+    """Row gather for UNSORTED ids whose VJP still runs the sorted fast
+    path: the plan carries a static permutation ``perm`` with
+    ``ids[perm]`` monotone (``EdgePlan.halo_sort_perm``), so the transpose
+    is gather-by-perm (cheap, col-split) + sorted segment-sum (Pallas MXU)
+    instead of XLA's generic scatter-add (~2x slower at arxiv scale)."""
+
+    @jax.custom_vjp
+    def take(x, idx, perm, sorted_ids):
+        return row_take(x, idx, col_block, oob="fill")
+
+    def fwd(x, idx, perm, sorted_ids):
+        return take(x, idx, perm, sorted_ids), (perm, sorted_ids)
+
+    def bwd(res, g):
+        perm, sorted_ids = res
+        gp = row_take(g, perm, col_block)  # static permutation, in-range
+        dx = _sorted_segment_sum_any(gp, sorted_ids, n_rows, be, bn, mc)
+        return dx, None, None, None
+
+    take.defvjp(fwd, bwd)
+    return take
+
+
+def take_rows_sort_route(x, idx, perm, sorted_ids, *, pallas_hints,
+                         col_block=None):
+    """``x[idx]`` (OOB -> 0) with the VJP routed through a plan-provided
+    sorting permutation of ``idx`` (see :func:`_make_take_rows_sortroute`)."""
+    if col_block is None:
+        from dgraph_tpu import config as _cfg
+
+        col_block = _cfg.gather_col_block
+    be, bn, mc = pallas_hints
+    return _make_take_rows_sortroute(x.shape[0], col_block, be, bn, mc)(
+        x, idx, perm, sorted_ids
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _make_segment_sum_sortroute(n_rows, col_block, be, bn, mc):
+    """segment-sum for UNSORTED ids via the plan's sorting permutation:
+    forward = gather-by-perm + sorted segment-sum (Pallas MXU); VJP = plain
+    row gather by the original ids (the composite's exact transpose —
+    d_data[i] = g[ids[i]] — so the permutation drops out of the backward)."""
+
+    @jax.custom_vjp
+    def segsum(data, ids, perm, sorted_ids):
+        dp = row_take(data, perm, col_block)
+        return _sorted_segment_sum_any(dp, sorted_ids, n_rows, be, bn, mc)
+
+    def fwd(data, ids, perm, sorted_ids):
+        return segsum(data, ids, perm, sorted_ids), ids
+
+    def bwd(ids, g):
+        return row_take(g, ids, col_block, oob="fill"), None, None, None
+
+    segsum.defvjp(fwd, bwd)
+    return segsum
+
+
+def segment_sum_sort_route(data, ids, perm, sorted_ids, n_rows, *,
+                           pallas_hints, col_block=None):
+    """Segment-sum of rows with unsorted ``ids`` routed through the plan's
+    sorting permutation (see :func:`_make_segment_sum_sortroute`)."""
+    if col_block is None:
+        from dgraph_tpu import config as _cfg
+
+        col_block = _cfg.gather_col_block
+    be, bn, mc = pallas_hints
+    return _make_segment_sum_sortroute(n_rows, col_block, be, bn, mc)(
+        data, ids, perm, sorted_ids
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def _make_segment_sum(num_segments, sorted_ids, col_block):
     """segment_sum whose VJP is a column-split take (the >128-lane row

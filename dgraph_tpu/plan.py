@@ -293,6 +293,7 @@ class HaloSpec:
         "scatter_block_e",
         "scatter_block_n",
         "halo_deltas",
+        "halo_sort_mc",
     )
 )
 class EdgePlan:
@@ -349,6 +350,17 @@ class EdgePlan:
     # padded all_to_all — SURVEY §7 "ppermute rounds only to actual
     # neighbors". () means no cross-rank traffic.
     halo_deltas: tuple = ()
+    # Sorted route for the HALO-side index (whose ids are NOT monotone —
+    # local rows then halo slots): a static permutation putting them in
+    # sorted order, so the halo-side gather's VJP and the halo-side
+    # scatter's forward run as gather-by-perm + sorted segment-sum (Pallas
+    # MXU kernel) instead of XLA's generic unsorted scatter-add (measured
+    # ~2x slower at arxiv scale, ops/local.py). None on plans built with
+    # sort_route=False (e.g. billion-edge plans where the extra 2x[W,E]
+    # int32 isn't worth host RAM).
+    halo_sort_perm: Any = None  # i32[W, E] or None
+    halo_sorted_ids: Any = None  # i32[W, E] or None
+    halo_sort_mc: int = 1  # static; max_chunks hint for the sorted route
 
 
 def plan_memory_usage(plan: EdgePlan, feature_dim: int, dtype_bytes: int = 4) -> dict:
@@ -361,6 +373,8 @@ def plan_memory_usage(plan: EdgePlan, feature_dim: int, dtype_bytes: int = 4) ->
     """
     W, S = plan.world_size, plan.halo.s_pad
     idx_bytes = plan.e_pad * 4 * 2 + plan.e_pad * 4  # src/dst idx + mask
+    if plan.halo_sort_perm is not None:
+        idx_bytes += plan.e_pad * 4 * 2  # halo_sort_perm + halo_sorted_ids
     send_bytes = W * S * (4 + 4)  # send_idx + send_mask
     halo_buffer = W * S * feature_dim * dtype_bytes
     send_buffer = W * S * feature_dim * dtype_bytes
@@ -509,6 +523,8 @@ def build_edge_plan(
     pad_multiple: int = 8,
     sort_edges: bool = True,
     use_native: Optional[bool] = None,  # None = auto (E >= NATIVE_PLAN_MIN_EDGES)
+    sort_route: Optional[bool] = None,  # None = auto (skip at billion-edge
+    # scale: the two extra [W, E] int32 arrays aren't worth host RAM there)
 ) -> tuple[EdgePlan, EdgePlanLayout]:
     """Build the padded SPMD plan for one edge set.
 
@@ -552,6 +568,8 @@ def build_edge_plan(
 
     if use_native is None:
         use_native = sort_edges and _native.available() and E >= NATIVE_PLAN_MIN_EDGES
+    if sort_route is None:
+        sort_route = E < NATIVE_PLAN_MIN_EDGES
     if use_native:
         if not sort_edges:
             raise ValueError("native plan core always owner-sorts (sort_edges=True)")
@@ -559,6 +577,7 @@ def build_edge_plan(
             src, dst, src_partition, dst_partition, src_offsets, dst_offsets,
             src_counts, dst_counts, W, edge_owner, homogeneous,
             n_src_pad, n_dst_pad, e_pad, s_pad, pad_multiple,
+            sort_route=sort_route,
         )
 
     if edge_owner == "dst":  # validated above, before the native dispatch
@@ -686,7 +705,7 @@ def build_edge_plan(
         owner_sorted=sort_edges,
         halo_deltas=tuple(int(d) for d in np.unique((needer - sender) % W)),
         edge_rank=edge_rank, edge_slot=edge_slot, halo_counts=halo_counts,
-        tag="",
+        tag="", sort_route=sort_route,
     )
 
 
@@ -694,7 +713,7 @@ def _finalize_plan(
     *, src_idx_arr, dst_idx_arr, edge_mask, src_counts, dst_counts, e_counts,
     send_idx, send_mask, s_pad_val, W, E, n_src_pad_val, n_dst_pad_val,
     e_pad_val, halo_side, homogeneous, edge_owner, owner_sorted, halo_deltas,
-    edge_rank, edge_slot, halo_counts, tag: str,
+    edge_rank, edge_slot, halo_counts, tag: str, sort_route: bool,
 ) -> tuple[EdgePlan, EdgePlanLayout]:
     """Shared assembly tail of the numpy and native plan builders: Pallas
     scheduling hints, EdgePlan/EdgePlanLayout construction, efficiency log.
@@ -716,6 +735,28 @@ def _finalize_plan(
     else:
         scatter_mc = 1
 
+    # halo-side sorted route (see EdgePlan.halo_sort_perm)
+    halo_sort_perm = halo_sorted_ids = None
+    halo_sort_mc = 1
+    if sort_route:
+        from dgraph_tpu.ops.pallas_segment import max_chunks_hint
+
+        halo_idx_arr = src_idx_arr if halo_side == "src" else dst_idx_arr
+        n_halo_rows = (
+            n_src_pad_val if halo_side == "src" else n_dst_pad_val
+        ) + W * s_pad_val
+        halo_sort_perm = np.argsort(halo_idx_arr, axis=1, kind="stable").astype(
+            np.int32
+        )
+        halo_sorted_ids = np.take_along_axis(halo_idx_arr, halo_sort_perm, axis=1)
+        halo_sort_mc = max(
+            max_chunks_hint(
+                halo_sorted_ids[r], n_halo_rows,
+                block_e=scatter_block_e, block_n=scatter_block_n,
+            )
+            for r in range(W)
+        )
+
     plan = EdgePlan(
         src_index=src_idx_arr,
         dst_index=dst_idx_arr,
@@ -735,6 +776,9 @@ def _finalize_plan(
         scatter_block_e=scatter_block_e,
         scatter_block_n=scatter_block_n,
         halo_deltas=halo_deltas,
+        halo_sort_perm=halo_sort_perm,
+        halo_sorted_ids=halo_sorted_ids,
+        halo_sort_mc=halo_sort_mc,
     )
     layout = EdgePlanLayout(
         edge_rank=edge_rank,
@@ -758,6 +802,7 @@ def _build_edge_plan_native(
     src, dst, src_partition, dst_partition, src_offsets, dst_offsets,
     src_counts, dst_counts, W, edge_owner, homogeneous,
     n_src_pad, n_dst_pad, e_pad, s_pad, pad_multiple,
+    sort_route: bool,
 ) -> tuple[EdgePlan, EdgePlanLayout]:
     """Billion-edge path: the per-edge sort/dedup/fill runs in the native
     core (csrc plan_core_*, bounded-memory radix sorts) and numpy only
@@ -809,7 +854,7 @@ def _build_edge_plan_native(
         owner_sorted=True,
         halo_deltas=tuple(int(d) for d in np.unique((needer_r - sender_r) % W)),
         edge_rank=edge_rank.astype(np.int64), edge_slot=edge_slot,
-        halo_counts=halo_counts, tag=" (native core)",
+        halo_counts=halo_counts, tag=" (native core)", sort_route=sort_route,
     )
 
 
