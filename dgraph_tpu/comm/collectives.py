@@ -233,9 +233,9 @@ def scatter_sum(
     if side != plan.halo_side:
         # owner-side aggregation: plan-sorted monotone segment ids ride the
         # shared Pallas-or-jnp dispatch (kill switch + precision policy in
-        # ONE place: ops.local._sorted_segment_sum_any)
+        # ONE place: ops.local.sorted_segment_sum_any)
         if plan.owner_sorted:
-            return local_ops._sorted_segment_sum_any(
+            return local_ops.sorted_segment_sum_any(
                 edata, idx, n_pad, plan.scatter_block_e, plan.scatter_block_n,
                 plan.scatter_mc,
             )

@@ -138,7 +138,7 @@ def take_rows(
     )(x, idx)
 
 
-def _sorted_segment_sum_any(data, sorted_ids, n_rows, be, bn, mc):
+def sorted_segment_sum_any(data, sorted_ids, n_rows, be, bn, mc):
     """Sorted segment-sum via the Pallas MXU kernel when it's enabled AND
     the backend is TPU, jnp elsewhere. The single dispatch point for every
     sorted reduction (owner-side scatter and the halo sort route) so the
@@ -178,7 +178,7 @@ def _make_take_rows_sortroute(n_rows, col_block, be, bn, mc):
     def bwd(res, g):
         perm, sorted_ids = res
         gp = row_take(g, perm, col_block)  # static permutation, in-range
-        dx = _sorted_segment_sum_any(gp, sorted_ids, n_rows, be, bn, mc)
+        dx = sorted_segment_sum_any(gp, sorted_ids, n_rows, be, bn, mc)
         return dx, None, None, None
 
     take.defvjp(fwd, bwd)
@@ -209,7 +209,7 @@ def _make_segment_sum_sortroute(n_rows, col_block, be, bn, mc):
     @jax.custom_vjp
     def segsum(data, ids, perm, sorted_ids):
         dp = row_take(data, perm, col_block)
-        return _sorted_segment_sum_any(dp, sorted_ids, n_rows, be, bn, mc)
+        return sorted_segment_sum_any(dp, sorted_ids, n_rows, be, bn, mc)
 
     def fwd(data, ids, perm, sorted_ids):
         return segsum(data, ids, perm, sorted_ids), ids

@@ -15,10 +15,19 @@ Maps the reference's parallelism inventory (SURVEY.md §2.3) onto mesh axes:
   ``distributed_layers.py:22-207``):
   :class:`~dgraph_tpu.models.norm.DistributedBatchNorm`.
 
+- Sequence/context parallelism (absent in the reference; first-class here):
+  ring attention with K/V blocks streaming over ``lax.ppermute`` —
+  :mod:`dgraph_tpu.parallel.sequence`.
+
 Tensor/pipeline/expert parallelism are absent in the reference (SURVEY §2.3)
 and in scope for later rounds here.
 """
 
+from dgraph_tpu.parallel.sequence import (
+    dense_attention,
+    ring_attention,
+    ring_attention_sharded,
+)
 from dgraph_tpu.comm import collectives
 from dgraph_tpu.comm.collectives import (
     gather,
@@ -38,6 +47,9 @@ from dgraph_tpu.comm.mesh import (
 )
 
 __all__ = [
+    "dense_attention",
+    "ring_attention",
+    "ring_attention_sharded",
     "collectives",
     "gather",
     "gather_concat",
