@@ -97,7 +97,7 @@ def ring_attention(
     qf = q.astype(jnp.float32)
     # constants must be marked device-varying over the ring axis or the
     # scan carry types mismatch (shard_map varying-axis tracking)
-    vary = functools.partial(lax.pvary, axis_name=axis_name)
+    vary = lambda t: lax.pcast(t, axis_name, to="varying")
     m0 = vary(jnp.full((T, H), NEG_BIG, jnp.float32))
     l0 = vary(jnp.zeros((T, H), jnp.float32))
     o0 = vary(jnp.zeros((T, H, D), jnp.float32))

@@ -37,6 +37,12 @@ class Config:
     n_long: int = 11
     out: Optional[str] = "logs/kernel_benchmarks.jsonl"
     pallas: bool = True  # include the Pallas sorted-segment-sum variants
+    dtypes: str = "float32"  # comma list: float32,bfloat16
+    # tile sweep for the Pallas kernel (grid-step overhead dominates at
+    # small block_e: fewer/bigger DMAs win until VMEM pressure pushes back)
+    sweep: bool = False
+    sweep_block_e: str = "512,1024,2048,4096"
+    sweep_block_n: str = "256,512"
 
 
 def _bench(op, arg, *, reps: int, n_long: int):
@@ -48,7 +54,9 @@ def _bench(op, arg, *, reps: int, n_long: int):
     @partial(jax.jit, static_argnames="n")
     def loop(a, s, n):
         def body(acc, _):
-            out = op(a + acc)
+            # serialize iterations WITHOUT promoting a's dtype (a + f32
+            # scalar would silently run every bf16 benchmark in f32)
+            out = op(a + acc.astype(a.dtype) * 0)
             return acc + out.ravel()[0].astype(jnp.float32) * 1e-20, None
 
         acc, _ = jax.lax.scan(body, s, None, length=n)
@@ -93,33 +101,52 @@ def main(cfg: Config):
     sids = jnp.asarray(sids_np)
     on_tpu = jax.default_backend() == "tpu"
 
+    dtype_list = [
+        jnp.bfloat16 if d.strip() in ("bfloat16", "bf16") else jnp.float32
+        for d in cfg.dtypes.split(",")
+    ]
     for F in [int(f) for f in cfg.feat_dims.split(",")]:
-        x = jnp.asarray(rng.standard_normal((N, F)), jnp.float32)
-        ed = jnp.asarray(rng.standard_normal((E_pad, F)), jnp.float32)
+      for dt in dtype_list:
+        b = 2 if dt == jnp.bfloat16 else 4
+        dname = "bf16" if dt == jnp.bfloat16 else "f32"
+        x = jnp.asarray(rng.standard_normal((N, F)), dt)
+        ed = jnp.asarray(rng.standard_normal((E_pad, F)), dt)
         bench = partial(_bench, reps=cfg.reps, n_long=cfg.n_long)
 
         t = bench(lambda a: a[idx], x)
-        record(op="gather_plain", F=F, ms=round(t, 3),
-               gbps=round(E_pad * F * 4 / t / 1e6, 1))
+        record(op="gather_plain", F=F, dtype=dname, ms=round(t, 3),
+               gbps=round(E_pad * F * b / t / 1e6, 1))
         t = bench(lambda a: local_ops.row_take(a, idx, col_block=128), x)
-        record(op="gather_col_split", F=F, ms=round(t, 3),
-               gbps=round(E_pad * F * 4 / t / 1e6, 1))
+        record(op="gather_col_split", F=F, dtype=dname, ms=round(t, 3),
+               gbps=round(E_pad * F * b / t / 1e6, 1))
         t = bench(
             lambda a: local_ops.segment_sum(a, sids, N, indices_are_sorted=True), ed
         )
-        record(op="segment_sum_xla", F=F, ms=round(t, 3),
-               gbps=round(E_pad * F * 4 / t / 1e6, 1))
+        record(op="segment_sum_xla", F=F, dtype=dname, ms=round(t, 3),
+               gbps=round(E_pad * F * b / t / 1e6, 1))
         if cfg.pallas and on_tpu:
-            mc = max_chunks_hint(sids_np, N)
-            for prec in ("highest", "default"):
-                t = bench(
-                    lambda a, prec=prec: sorted_segment_sum(
-                        a, sids, N, max_chunks_per_block=mc, precision=prec
-                    ),
-                    ed,
-                )
-                record(op=f"segment_sum_pallas_{prec}", F=F, ms=round(t, 3),
-                       gbps=round(E_pad * F * 4 / t / 1e6, 1))
+            if cfg.sweep:
+                tiles = [
+                    (int(be), int(bn))
+                    for be in cfg.sweep_block_e.split(",")
+                    for bn in cfg.sweep_block_n.split(",")
+                ]
+            else:
+                tiles = [(1024, 256)]
+            for be, bn in tiles:
+                mc = max_chunks_hint(sids_np, N, block_e=be, block_n=bn)
+                precs = ("default",) if dt == jnp.bfloat16 else ("highest", "default")
+                for prec in precs:
+                    t = bench(
+                        lambda a, prec=prec, be=be, bn=bn, mc=mc: sorted_segment_sum(
+                            a, sids, N, max_chunks_per_block=mc,
+                            block_e=be, block_n=bn, precision=prec,
+                        ),
+                        ed,
+                    )
+                    record(op=f"segment_sum_pallas_{prec}", F=F, dtype=dname,
+                           block_e=be, block_n=bn, mc=mc, ms=round(t, 3),
+                           gbps=round(E_pad * F * b / t / 1e6, 1))
 
     if cfg.out:
         os.makedirs(os.path.dirname(cfg.out) or ".", exist_ok=True)

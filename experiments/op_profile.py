@@ -1,0 +1,138 @@
+"""Where does a GCN epoch go? Times every constituent op of the arxiv-scale
+epoch on the local accelerator, in the exact form the model invokes it
+(collectives layer + gradient round trips), so regressions in any one
+VJP/kernel routing show up as a single line.
+
+The epoch-level companion of ``kernel_benchmarks.py`` — that file times raw
+kernels; this one times the framework ops (gather/scatter with plan
+routing, sort-route VJPs) whose composition IS the training step. Mirrors
+the reference's per-phase timing harness (``experiments/OGB/main.py:129-221``
+prints gather/scatter/comm phase times per epoch).
+
+Usage:
+    python experiments/op_profile.py              # arxiv scale, bf16
+    DGRAPH_TPU_PALLAS_SCATTER=0 python experiments/op_profile.py  # XLA-only
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    num_nodes: int = 169_343
+    num_edges_half: int = 1_166_243  # symmetrized x2
+    hidden: int = 256
+    dtype: str = "bfloat16"
+    reps: int = 3
+    n_long: int = 8
+    out: Optional[str] = "logs/op_profile.jsonl"
+
+
+def log(m):
+    print(f"[{time.strftime('%H:%M:%S')}] {m}", file=sys.stderr, flush=True)
+
+
+def main(cfg: Config):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from dgraph_tpu import config as fw_cfg
+    from dgraph_tpu.comm import collectives as coll
+    from dgraph_tpu.ops import local as L
+    from dgraph_tpu.plan import build_edge_plan
+
+    V, H = cfg.num_nodes, cfg.hidden
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, V, cfg.num_edges_half)
+    dst = rng.integers(0, V, cfg.num_edges_half)
+    edge_index = np.stack(
+        [np.concatenate([src, dst]), np.concatenate([dst, src])]
+    ).astype(np.int64)
+    plan_np, _ = build_edge_plan(
+        edge_index, np.zeros(V, np.int32), world_size=1, edge_owner="dst",
+        pad_multiple=128,
+    )
+    plan = jax.tree.map(lambda leaf: jnp.asarray(np.asarray(leaf)[0]), plan_np)
+    jax.block_until_ready([t for t in jax.tree.leaves(plan)])
+    log(f"plan: e_pad={plan_np.e_pad} n_src_pad={plan_np.n_src_pad} "
+        f"scatter_mc={plan_np.scatter_mc} halo_sort_mc={plan_np.halo_sort_mc} "
+        f"pallas={fw_cfg.pallas_scatter_enabled()}")
+
+    dt = jnp.bfloat16 if cfg.dtype in ("bfloat16", "bf16") else jnp.float32
+    Np, Ep = plan_np.n_src_pad, plan_np.e_pad
+    x_n = jax.random.normal(jax.random.key(0), (Np, H), dt)
+    x_e = jax.random.normal(jax.random.key(1), (Ep, H), dt)
+    w = jax.random.normal(jax.random.key(2), (H, H), dt)
+    jax.block_until_ready((x_n, x_e, w))
+
+    records = []
+
+    def timed(name, fn, *args):
+        @functools.partial(jax.jit, static_argnames="n")
+        def scan(c0, n):
+            def body(c, _):
+                r = fn(*args, c)
+                return c + r.ravel()[0].astype(jnp.float32) * 1e-30, None
+
+            c, _ = jax.lax.scan(body, c0, None, length=n)
+            return c
+
+        float(scan(jnp.float32(0.0), 1))
+        float(scan(jnp.float32(0.0), cfg.n_long))
+        best = None
+        for _ in range(cfg.reps):
+            t0 = time.perf_counter(); float(scan(jnp.float32(0.0), 1))
+            t1 = time.perf_counter() - t0
+            t0 = time.perf_counter(); float(scan(jnp.float32(0.0), cfg.n_long))
+            tl = time.perf_counter() - t0
+            d = (tl - t1) / (cfg.n_long - 1) * 1000.0
+            if d > 0 and (best is None or d < best):
+                best = d
+        rec = {"op": name, "ms": round(best, 3) if best else None,
+               "H": H, "dtype": cfg.dtype, "ts": time.time()}
+        records.append(rec)
+        print(json.dumps(rec))
+        return best
+
+    c = lambda carry: carry.astype(dt) * 0  # serialize scan iterations
+
+    timed("matmul_NxHxH", lambda cc: (x_n + c(cc)) @ w)
+    timed("gather_dst_owner", lambda cc: coll.gather(x_n + c(cc), plan, "dst", None))
+    timed("gather_src_halo", lambda cc: coll.gather(x_n + c(cc), plan, "src", None))
+    timed("scatter_sum_dst", lambda cc: coll.scatter_sum(x_e + c(cc), plan, "dst", None))
+    timed("scatter_sum_src_halo", lambda cc: coll.scatter_sum(x_e + c(cc), plan, "src", None))
+
+    def g_loss(xn, cc, side):
+        out = coll.gather(xn + c(cc), plan, side, None)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    timed("grad_gather_dst", lambda cc: jax.grad(g_loss)(x_n, cc, "dst"))
+    timed("grad_gather_src", lambda cc: jax.grad(g_loss)(x_n, cc, "src"))
+
+    def s_loss(xe, cc, side):
+        out = coll.scatter_sum(xe + c(cc), plan, side, None)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    timed("grad_scatter_dst", lambda cc: jax.grad(s_loss)(x_e, cc, "dst"))
+
+    if cfg.out:
+        os.makedirs(os.path.dirname(cfg.out) or ".", exist_ok=True)
+        with open(cfg.out, "a") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from dgraph_tpu.utils.cli import parse_config
+
+    main(parse_config(Config))
