@@ -94,3 +94,53 @@ def trace_to(logdir: str):
 
 
 named_scope = jax.named_scope
+
+
+def salt_input(a, salt):
+    """Fold a scan-carry scalar into an op input WITHOUT changing its value
+    or dtype: ``a + cast(salt)*0`` keeps a data dependence (XLA cannot fold
+    x*0 for floats — NaN/Inf semantics) so scan iterations serialize, and
+    the cast avoids promoting bf16 inputs to the f32 carry dtype (which
+    would silently benchmark f32 kernels)."""
+    return a + salt.astype(a.dtype) * 0
+
+
+def timed_scan_ms(fn, *, reps: int = 3, n_long: int = 8):
+    """Best positive (long - short) / (n_long - 1) delta in ms for one op.
+
+    The single-chip timing protocol (see bench.py's rationale): on the
+    tunneled TPU ``block_until_ready`` is not a reliable completion barrier
+    and identical dispatches can be memoized, so run the op n times INSIDE
+    one jit via ``lax.scan`` with a scalar carry fetched to host, and
+    subtract a 1-iteration run so per-call RPC latency cancels.
+
+    ``fn(salt)`` must return an array and fold ``salt`` (f32 scalar) into
+    its inputs via :func:`salt_input`. Returns None if no rep produced a
+    positive delta (wedged/noisy tunnel).
+    """
+    import functools
+    import time as _time
+
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames="n")
+    def loop(s, n):
+        def body(acc, _):
+            out = fn(acc)
+            return acc + out.ravel()[0].astype(jnp.float32) * 1e-20, None
+
+        acc, _ = jax.lax.scan(body, s, None, length=n)
+        return acc
+
+    float(loop(jnp.float32(0), 1))
+    float(loop(jnp.float32(0), n_long))
+    best = None
+    for _ in range(reps):
+        t0 = _time.perf_counter(); float(loop(jnp.float32(0), 1))
+        t1 = _time.perf_counter() - t0
+        t0 = _time.perf_counter(); float(loop(jnp.float32(0), n_long))
+        tl = _time.perf_counter() - t0
+        d = (tl - t1) / (n_long - 1) * 1000.0
+        if d > 0 and (best is None or d < best):
+            best = d
+    return best

@@ -46,35 +46,15 @@ class Config:
 
 
 def _bench(op, arg, *, reps: int, n_long: int):
-    """Median positive delta (ms per op) between 1- and n_long-iteration
-    in-jit scans, each forced complete by a scalar fetch."""
-    import jax
-    import jax.numpy as jnp
+    """One op's in-jit scan timing — delegates to the shared protocol
+    (``dgraph_tpu.utils.timing.timed_scan_ms``; ``salt_input`` keeps bf16
+    inputs bf16)."""
+    from dgraph_tpu.utils.timing import salt_input, timed_scan_ms
 
-    @partial(jax.jit, static_argnames="n")
-    def loop(a, s, n):
-        def body(acc, _):
-            # serialize iterations WITHOUT promoting a's dtype (a + f32
-            # scalar would silently run every bf16 benchmark in f32)
-            out = op(a + acc.astype(a.dtype) * 0)
-            return acc + out.ravel()[0].astype(jnp.float32) * 1e-20, None
-
-        acc, _ = jax.lax.scan(body, s, None, length=n)
-        return acc
-
-    float(loop(arg, jnp.float32(0), 1))
-    float(loop(arg, jnp.float32(0), n_long))
-    deltas = []
-    for r in range(reps):
-        t0 = time.perf_counter()
-        float(loop(arg, jnp.float32(r + 1), 1))
-        t1 = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        float(loop(arg, jnp.float32(r + 101), n_long))
-        tl = time.perf_counter() - t0
-        deltas.append((tl - t1) / (n_long - 1) * 1000.0)
-    pos = sorted(d for d in deltas if d > 0)
-    return pos[len(pos) // 2] if pos else max(deltas)
+    t = timed_scan_ms(
+        lambda s: op(salt_input(arg, s)), reps=reps, n_long=n_long
+    )
+    return t if t is not None else float("nan")  # NaN survives round()
 
 
 def main(cfg: Config):

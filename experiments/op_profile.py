@@ -17,7 +17,6 @@ Usage:
 from __future__ import annotations
 
 import dataclasses
-import functools
 import json
 import os
 import sys
@@ -74,36 +73,20 @@ def main(cfg: Config):
     w = jax.random.normal(jax.random.key(2), (H, H), dt)
     jax.block_until_ready((x_n, x_e, w))
 
+    from dgraph_tpu.utils.timing import timed_scan_ms
+
     records = []
 
-    def timed(name, fn, *args):
-        @functools.partial(jax.jit, static_argnames="n")
-        def scan(c0, n):
-            def body(c, _):
-                r = fn(*args, c)
-                return c + r.ravel()[0].astype(jnp.float32) * 1e-30, None
-
-            c, _ = jax.lax.scan(body, c0, None, length=n)
-            return c
-
-        float(scan(jnp.float32(0.0), 1))
-        float(scan(jnp.float32(0.0), cfg.n_long))
-        best = None
-        for _ in range(cfg.reps):
-            t0 = time.perf_counter(); float(scan(jnp.float32(0.0), 1))
-            t1 = time.perf_counter() - t0
-            t0 = time.perf_counter(); float(scan(jnp.float32(0.0), cfg.n_long))
-            tl = time.perf_counter() - t0
-            d = (tl - t1) / (cfg.n_long - 1) * 1000.0
-            if d > 0 and (best is None or d < best):
-                best = d
+    def timed(name, fn):
+        """fn(salt) -> array; shared scan protocol (utils.timing)."""
+        best = timed_scan_ms(fn, reps=cfg.reps, n_long=cfg.n_long)
         rec = {"op": name, "ms": round(best, 3) if best else None,
                "H": H, "dtype": cfg.dtype, "ts": time.time()}
         records.append(rec)
         print(json.dumps(rec))
         return best
 
-    c = lambda carry: carry.astype(dt) * 0  # serialize scan iterations
+    c = lambda salt: salt.astype(dt) * 0  # fold salt in without promotion
 
     timed("matmul_NxHxH", lambda cc: (x_n + c(cc)) @ w)
     timed("gather_dst_owner", lambda cc: coll.gather(x_n + c(cc), plan, "dst", None))

@@ -1,0 +1,106 @@
+"""Preemption-aware training (train/elastic.py) — beyond-reference
+subsystem (SURVEY §5: failure detection/elastic absent in the reference)."""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dgraph_tpu.train.checkpoint import latest_step, restore_checkpoint
+from dgraph_tpu.train.elastic import (
+    PreemptionGuard,
+    StepWatchdog,
+    run_elastic,
+)
+
+
+def _mk_step():
+    def step(state):
+        return {"w": state["w"] + 1.0}
+
+    return step
+
+
+def test_runs_to_completion_and_checkpoints(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    state, last, preempted = run_elastic(
+        _mk_step(), {"w": jnp.zeros(3)}, start_step=0, num_steps=5,
+        ckpt_dir=ckpt, guard=PreemptionGuard(signals=()),
+    )
+    assert not preempted and last == 5
+    assert float(state["w"][0]) == 5.0
+    assert latest_step(ckpt) == 5
+    got = restore_checkpoint(ckpt, {"state": {"w": jnp.zeros(3)}, "step": 0})
+    assert got["step"] == 5
+    np.testing.assert_allclose(np.asarray(got["state"]["w"]), 5.0)
+
+
+def test_preemption_saves_and_stops(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    guard = PreemptionGuard(signals=())
+    calls = {"n": 0}
+
+    def step(state):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            guard.request_stop()  # "SIGTERM" lands during step 3
+        return {"w": state["w"] + 1.0}
+
+    state, last, preempted = run_elastic(
+        step, {"w": jnp.zeros(2)}, start_step=0, num_steps=100,
+        ckpt_dir=ckpt, guard=guard,
+    )
+    assert preempted and last == 3  # stopped right after the signaled step
+    assert latest_step(ckpt) == 3
+
+    # resume from the checkpoint: continues exactly where it stopped
+    got = restore_checkpoint(ckpt, {"state": {"w": jnp.zeros(2)}, "step": 0})
+    state2, last2, pre2 = run_elastic(
+        _mk_step(), got["state"], start_step=got["step"], num_steps=6,
+        ckpt_dir=ckpt, guard=PreemptionGuard(signals=()),
+    )
+    assert not pre2 and last2 == 6
+    np.testing.assert_allclose(np.asarray(state2["w"]), 6.0)
+
+
+def test_sigterm_handler_sets_flag():
+    guard = PreemptionGuard(signals=(signal.SIGUSR1,))
+    try:
+        assert not guard.should_stop()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        for _ in range(100):
+            if guard.should_stop():
+                break
+            time.sleep(0.01)
+        assert guard.should_stop()
+    finally:
+        guard.uninstall()
+
+
+def test_watchdog_fires_on_stall():
+    fired = threading.Event()
+    dog = StepWatchdog(0.3, on_expire=fired.set)
+    try:
+        time.sleep(0.15)
+        dog.beat()  # healthy heartbeat defers expiry
+        assert not fired.is_set()
+        assert fired.wait(timeout=3.0)  # then stall -> expires
+    finally:
+        dog.stop()
+
+
+def test_watchdog_quiet_when_beating():
+    fired = threading.Event()
+    dog = StepWatchdog(0.5, on_expire=fired.set)
+    try:
+        for _ in range(4):
+            time.sleep(0.1)
+            dog.beat()
+        assert not fired.is_set()
+    finally:
+        dog.stop()
