@@ -93,6 +93,27 @@ class _BaseComm:
         recv = lax.all_to_all(send, self.graph_axis, split_axis=0, concat_axis=0)
         return recv.reshape(W * S, F)
 
+    def seq_attention(self, q, k, v, *, causal: bool = False, kv_mask=None):
+        """Exact attention over the axis-sharded token/vertex dimension.
+
+        ``tpu`` mode runs ring attention (K/V blocks stream around the
+        graph axis via ppermute — :mod:`dgraph_tpu.parallel.sequence`);
+        ``single`` mode is the dense oracle. Same dual-impl pattern as
+        every other primitive on this facade: model code is byte-identical
+        under either comm.
+
+        Args:
+          q/k/v: [T_loc, H, D] per-shard (full [T, H, D] in single mode).
+          kv_mask: [T_loc] 1.0 = real position (padding excluded from keys).
+        """
+        from dgraph_tpu.parallel.sequence import dense_attention, ring_attention
+
+        if self.graph_axis is None:
+            return dense_attention(q, k, v, causal=causal, kv_mask=kv_mask)
+        return ring_attention(
+            q, k, v, self.graph_axis, causal=causal, kv_mask=kv_mask
+        )
+
     # -- reductions over mesh axes --
     def all_reduce_sum(self, x):
         if self.graph_axis is None:

@@ -4,8 +4,11 @@ from dgraph_tpu.models.sage import SAGEConv, GraphSAGE
 from dgraph_tpu.models.gat import GATConv, GAT
 from dgraph_tpu.models.norm import DistributedBatchNorm
 from dgraph_tpu.models.rgat import RGAT, RGATLayer, RelationalAttention
+from dgraph_tpu.models.graph_transformer import GPSLayer, GraphTransformer
 
 __all__ = [
+    "GPSLayer",
+    "GraphTransformer",
     "RGAT",
     "RGATLayer",
     "RelationalAttention",

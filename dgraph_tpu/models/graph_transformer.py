@@ -1,0 +1,119 @@
+"""Graph transformer: local message passing + GLOBAL attention over all
+vertices, per layer (the GraphGPS recipe: MPNN branch + transformer branch,
+both residual).
+
+Beyond-reference model family: the reference's models are all local-k-hop
+(GCN/RGAT/GraphCast — SURVEY.md §2.5); long-range interactions need as
+many layers as the graph diameter. A global-attention branch captures them
+in one layer — and on TPU it rides the framework's sequence-parallel
+primitive: vertices are ALREADY sharded over the ``graph`` mesh axis, so
+global attention over the vertex set is exactly ring attention over that
+axis (:mod:`dgraph_tpu.parallel.sequence`, K/V blocks streaming via
+ppermute) — the same mesh, zero re-sharding. The local branch is the
+plan-based gather→dense→scatter every other model uses.
+
+Padded vertex slots are excluded from attention keys via ``kv_mask``
+(=DistributedGraph.vertex_mask); attention is permutation-equivariant, so
+the renumbered/sharded vertex order computes the same per-vertex function
+as the dense single-device oracle (pinned in tests/test_graph_transformer.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.models.mlp import MLP
+from dgraph_tpu.plan import EdgePlan
+
+
+class GPSLayer(nn.Module):
+    """One [local MPNN + global attention + FFN] block, all residual.
+
+    Pre-LN transformer convention; the MPNN branch is the split-projection
+    conv (same algebra as :class:`~dgraph_tpu.models.gcn.GraphConvLayer`).
+    """
+
+    latent: int
+    comm: Any
+    num_heads: int = 4
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, plan: EdgePlan, vmask):  # x: [n_pad, L]
+        from dgraph_tpu import config as _cfg
+
+        dt = _cfg.resolve_compute_dtype(self.dtype)
+        L, Hh = self.latent, self.num_heads
+        if L % Hh:
+            raise ValueError(f"latent {L} not divisible by heads {Hh}")
+        dh = L // Hh
+
+        # --- local branch: gather -> message -> scatter (dst-owned) ---
+        y = nn.LayerNorm(dtype=dt, name="ln_local")(x)
+        h_s = nn.Dense(L, use_bias=False, dtype=dt, name="src_proj")(y)
+        h_d = nn.Dense(L, dtype=dt, name="dst_proj")(y)
+        m = nn.silu(
+            self.comm.gather(h_s, plan, side="src")
+            + self.comm.gather(h_d, plan, side="dst")
+        )
+        local = self.comm.scatter_sum(m, plan, side="dst")
+        x = x + nn.Dense(L, dtype=dt, name="local_out")(local)
+
+        # --- global branch: ring attention over the vertex dimension ---
+        y = nn.LayerNorm(dtype=dt, name="ln_attn")(x)
+        qkv = nn.Dense(3 * L, dtype=dt, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        n = x.shape[0]
+        attn = self.comm.seq_attention(
+            q.reshape(n, Hh, dh), k.reshape(n, Hh, dh), v.reshape(n, Hh, dh),
+            kv_mask=vmask,
+        )
+        x = x + nn.Dense(L, dtype=dt, name="attn_out")(attn.reshape(n, L))
+
+        # --- FFN ---
+        y = nn.LayerNorm(dtype=dt, name="ln_ffn")(x)
+        x = x + MLP([2 * L, L], dtype=dt, name="ffn")(y)
+        # padded slots must stay exactly zero: they feed the NEXT layer's
+        # local scatter as src rows of cross-shard edges' padding and the
+        # residual stream would otherwise leak LayerNorm/FFN bias terms
+        # into them (real vertices are unaffected)
+        return x * vmask[:, None].astype(x.dtype)
+
+
+class GraphTransformer(nn.Module):
+    """Embed -> N x GPSLayer -> head. Signature matches the other model
+    families (x, plan, [edge_weight]) plus the vertex mask."""
+
+    latent: int
+    out_features: int
+    comm: Any
+    num_layers: int = 3
+    num_heads: int = 4
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,  # [n_pad, F]
+        plan: EdgePlan,
+        vmask: Optional[jax.Array] = None,  # [n_pad] 1.0 = real vertex
+    ) -> jax.Array:
+        from dgraph_tpu import config as _cfg
+
+        dt = _cfg.resolve_compute_dtype(self.dtype)
+        if vmask is None:
+            vmask = jnp.ones((x.shape[0],), jnp.float32)
+        h = nn.Dense(self.latent, dtype=dt, name="embed")(x)
+        h = h * vmask[:, None].astype(h.dtype)
+        for i in range(self.num_layers):
+            h = GPSLayer(
+                self.latent, comm=self.comm, num_heads=self.num_heads,
+                dtype=self.dtype, name=f"gps_{i}",
+            )(h, plan, vmask)
+        return nn.Dense(self.out_features, dtype=dt, name="head")(h).astype(
+            jnp.float32
+        )

@@ -107,6 +107,7 @@ class StepWatchdog:
         )
         self._last = time.monotonic()
         self._beaten = False
+        self._suspended = False
         self._on_expire = on_expire
         self._done = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -114,11 +115,32 @@ class StepWatchdog:
 
     def beat(self) -> None:
         """Mark the step boundary (call once per completed step)."""
-        self._beaten = True
+        # _last FIRST: the monitor must never pair the steady deadline with
+        # a first-step-age _last (race window if _beaten flipped first)
         self._last = time.monotonic()
+        self._beaten = True
+
+    def suspended(self):
+        """Context manager: pause expiry (e.g. around checkpoint saves —
+        a long orbax write is not a wedged device) and restart the clock
+        on exit."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            self._suspended = True
+            try:
+                yield
+            finally:
+                self._last = time.monotonic()
+                self._suspended = False
+
+        return cm()
 
     def _run(self) -> None:
         while not self._done.wait(min(self.deadline_s / 4, 5.0)):
+            if self._suspended:
+                continue
             limit = self.deadline_s if self._beaten else self.first_deadline_s
             if time.monotonic() - self._last > limit:
                 if self._on_expire is not None:
@@ -169,11 +191,22 @@ def run_elastic(
 
     if start_step >= num_steps:  # nothing to do (e.g. resuming a finished run)
         return state, start_step, False
+    import contextlib
+
     own_guard = guard is None
     guard = guard or PreemptionGuard()
     dog = StepWatchdog(step_deadline_s) if step_deadline_s > 0 else None
     preempted = False
     step = start_step
+    last_saved = None
+
+    def _save(st, n):
+        # a long orbax write is not a wedged device — pause the watchdog
+        nonlocal last_saved
+        with (dog.suspended() if dog is not None else contextlib.nullcontext()):
+            save_checkpoint(ckpt_dir, {"state": st, "step": n}, n)
+        last_saved = n
+
     try:
         for step in range(start_step, num_steps):
             state = train_step(state)
@@ -184,13 +217,13 @@ def run_elastic(
                 checkpoint_every > 0 and (step + 1) % checkpoint_every == 0
             )
             if ckpt_dir and is_lead and (done_now or periodic):
-                save_checkpoint(ckpt_dir, {"state": state, "step": step + 1}, step + 1)
+                _save(state, step + 1)
             if done_now:
                 preempted = True
                 break
         else:
-            if ckpt_dir and is_lead:
-                save_checkpoint(ckpt_dir, {"state": state, "step": num_steps}, num_steps)
+            if ckpt_dir and is_lead and last_saved != num_steps:
+                _save(state, num_steps)
     finally:
         if dog is not None:
             dog.stop()

@@ -31,15 +31,17 @@ class TrainState:
     step: int = 0
 
 
-def init_params(model, mesh, plan: EdgePlan, batch: dict, seed: int = 0):
+def init_params(model, mesh, plan: EdgePlan, batch: dict, seed: int = 0,
+                batch_args: Callable = None):
     """Initialize params under shard_map (the model's collectives need the
     mesh axis bound even at trace time). Same key on every shard ->
     deterministic identical params, declared replicated via out_specs P()."""
+    batch_args = batch_args or _batch_args
 
     def body(batch_, plan_):
         plan_s = squeeze_plan(plan_)
         b = jax.tree.map(lambda leaf: leaf[0], batch_)
-        return model.init(jax.random.key(seed), *_batch_args(b, plan_s))
+        return model.init(jax.random.key(seed), *batch_args(b, plan_s))
 
     batch_specs = jax.tree.map(lambda _: P(GRAPH_AXIS), batch)
     fn = jax.shard_map(
@@ -79,10 +81,20 @@ def masked_bce_multilabel(logits, labels, mask, axis_name):
 
 
 def _batch_args(b: dict, plan):
+    """Default model-arg builder: (x, plan, [edge_weight]) — the GCN-family
+    signature. Models with other signatures (e.g. GraphTransformer's
+    (x, plan, vmask)) pass a custom ``batch_args`` to the step builders /
+    ``fit``."""
     args = [b["x"], plan]
     if "edge_weight" in b:
         args.append(b["edge_weight"])
     return args
+
+
+def vmask_batch_args(b: dict, plan):
+    """(x, plan, vmask) — the GraphTransformer signature (global-attention
+    models need the vertex padding mask, not edge weights)."""
+    return [b["x"], plan, b["vmask"]]
 
 
 def make_train_step(
@@ -94,6 +106,7 @@ def make_train_step(
     loss_fn: Callable = masked_cross_entropy,
     donate: bool = True,
     per_replica_batch: bool = False,
+    batch_args: Callable = None,
 ):
     """Build a jitted SPMD train step: (params, opt_state, batch, plan) ->
     (params, opt_state, metrics).
@@ -114,6 +127,7 @@ def make_train_step(
     # the replica-sum into the DDP mean (graph-axis contributions are partial
     # sums of one sample and must stay a sum).
     num_replicas = dict(mesh.shape).get(REPLICA_AXIS, 1)
+    batch_args = batch_args or _batch_args
     batch_spec = (
         P(REPLICA_AXIS, GRAPH_AXIS) if per_replica_batch else P(GRAPH_AXIS)
     )
@@ -132,7 +146,7 @@ def make_train_step(
         b = _squeeze_batch(batch)
 
         def lf(p):
-            logits = model.apply(p, *_batch_args(b, plan))
+            logits = model.apply(p, *batch_args(b, plan))
             loss = loss_fn(logits, b["y"], b["mask"], GRAPH_AXIS)
             if b["y"].ndim == logits.ndim:
                 # multi-label float targets: per-label binary accuracy
@@ -176,13 +190,15 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 
-def make_eval_step(model, mesh, loss_fn: Callable = masked_cross_entropy):
+def make_eval_step(model, mesh, loss_fn: Callable = masked_cross_entropy,
+                   batch_args: Callable = None):
     """Jitted SPMD eval: (params, batch, plan) -> metrics dict."""
+    batch_args = batch_args or _batch_args
 
     def shard_body(params, batch, plan):
         plan = squeeze_plan(plan)
         b = jax.tree.map(lambda leaf: leaf[0], batch)
-        logits = model.apply(params, *_batch_args(b, plan))
+        logits = model.apply(params, *batch_args(b, plan))
         loss = loss_fn(logits, b["y"], b["mask"], GRAPH_AXIS)
         if b["y"].ndim == logits.ndim:
             hits = ((logits > 0) == (b["y"] > 0.5)).mean(axis=-1)
@@ -216,6 +232,7 @@ def fit(
     seed: int = 0,
     log_every: int = 0,
     loss_fn: Callable = masked_cross_entropy,
+    batch_args: Callable = None,
 ):
     """Convenience full-graph training driver (the ``_run_experiment`` loop,
     ``experiments/OGB/main.py:50-227``, as a function). Returns
@@ -223,16 +240,20 @@ def fit(
     import numpy as np
 
     optimizer = optimizer or optax.adam(1e-2)
-    batch_tr = dict(graph.batch("train"), y=graph.labels)
-    batch_va = dict(graph.batch("val"), y=graph.labels)
+    # vmask rides along for models whose batch_args want it (harmless
+    # otherwise — the default builder ignores unknown keys)
+    batch_tr = dict(graph.batch("train"), y=graph.labels, vmask=graph.vertex_mask)
+    batch_va = dict(graph.batch("val"), y=graph.labels, vmask=graph.vertex_mask)
     batch_tr = jax.tree.map(jnp.asarray, batch_tr)
     batch_va = jax.tree.map(jnp.asarray, batch_va)
     plan = jax.tree.map(jnp.asarray, graph.plan)
 
-    params = init_params(model, mesh, plan, batch_tr, seed)
+    params = init_params(model, mesh, plan, batch_tr, seed, batch_args=batch_args)
     opt_state = optimizer.init(params)
-    train_step = make_train_step(model, optimizer, mesh, plan, loss_fn=loss_fn)
-    eval_step = make_eval_step(model, mesh, loss_fn=loss_fn)
+    train_step = make_train_step(
+        model, optimizer, mesh, plan, loss_fn=loss_fn, batch_args=batch_args
+    )
+    eval_step = make_eval_step(model, mesh, loss_fn=loss_fn, batch_args=batch_args)
 
     history = []
     with jax.set_mesh(mesh):

@@ -135,10 +135,12 @@ def timed_scan_ms(fn, *, reps: int = 3, n_long: int = 8):
     float(loop(jnp.float32(0), 1))
     float(loop(jnp.float32(0), n_long))
     best = None
-    for _ in range(reps):
-        t0 = _time.perf_counter(); float(loop(jnp.float32(0), 1))
+    for r in range(reps):
+        # DISTINCT carry per dispatch: value-identical dispatches are the
+        # memoization case this whole protocol exists to avoid
+        t0 = _time.perf_counter(); float(loop(jnp.float32(r + 1), 1))
         t1 = _time.perf_counter() - t0
-        t0 = _time.perf_counter(); float(loop(jnp.float32(0), n_long))
+        t0 = _time.perf_counter(); float(loop(jnp.float32(r + 101), n_long))
         tl = _time.perf_counter() - t0
         d = (tl - t1) / (n_long - 1) * 1000.0
         if d > 0 and (best is None or d < best):

@@ -1,0 +1,100 @@
+"""GraphTransformer (GPS: local MPNN + global ring attention) tests:
+single-device dense oracle vs 8-way distributed logits, and training.
+
+Beyond-reference model family (the reference has only local-k-hop models,
+SURVEY.md §2.5); the global branch rides ring attention over the SAME
+graph mesh axis the vertices are sharded on."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dgraph_tpu.comm import Communicator
+from dgraph_tpu.data import DistributedGraph, synthetic
+from dgraph_tpu.models import GraphTransformer
+from dgraph_tpu.testing import spmd_apply
+from tests.test_models import build_graphs, to_original_order
+
+
+@pytest.fixture(scope="module")
+def sbm():
+    return synthetic.sbm_classification_graph(num_nodes=400, seed=1)
+
+
+def _model(comm):
+    return GraphTransformer(
+        latent=32, out_features=4, comm=comm, num_layers=2, num_heads=4
+    )
+
+
+def test_distributed_matches_single_device(mesh8, sbm):
+    g1 = build_graphs(sbm, 1)
+    g8 = build_graphs(sbm, 8)
+    model1 = _model(Communicator.init_process_group("single"))
+    model8 = _model(Communicator.init_process_group("tpu", world_size=8))
+
+    plan1 = jax.tree.map(lambda l: jnp.asarray(l[0]), g1.plan)
+    x1 = jnp.asarray(g1.features[0])
+    vm1 = jnp.asarray(g1.vertex_mask[0])
+    params = model1.init(jax.random.key(0), x1, plan1, vm1)
+    ref = to_original_order(np.asarray(model1.apply(params, x1, plan1, vm1))[None], g1)
+
+    def body(x, vm, plan_shard):
+        return model8.apply(params, x, plan_shard, vm)
+
+    out8 = spmd_apply(
+        mesh8, body, g8.plan, jnp.asarray(g8.features), jnp.asarray(g8.vertex_mask)
+    )
+    got = to_original_order(out8, g8)
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_padded_rows_stay_zero(mesh8, sbm):
+    """Residual stream on padded slots must remain exactly zero — they feed
+    the next layer's scatter via cross-shard padding edges."""
+    g1 = build_graphs(sbm, 1)
+    g8 = build_graphs(sbm, 8)
+    model8 = _model(Communicator.init_process_group("tpu", world_size=8))
+    # init via the single-comm twin (identical param tree; a TpuComm model
+    # can only init inside shard_map)
+    model1 = _model(Communicator.init_process_group("single"))
+    plan1 = jax.tree.map(lambda l: jnp.asarray(l[0]), g1.plan)
+    params = model1.init(
+        jax.random.key(0), jnp.asarray(g1.features[0]), plan1,
+        jnp.asarray(g1.vertex_mask[0]),
+    )
+
+    def body(x, vm, plan_shard):
+        return model8.apply(params, x, plan_shard, vm)
+
+    out8 = np.asarray(
+        spmd_apply(
+            mesh8, body, g8.plan, jnp.asarray(g8.features),
+            jnp.asarray(g8.vertex_mask),
+        )
+    )
+    vm = np.asarray(g8.vertex_mask)
+    # head bias makes padded logits constant-but-nonzero at the OUTPUT; the
+    # invariant we need is separability: padded rows all identical (no data
+    # leaked into them from real vertices)
+    pad_rows = out8[vm == 0]
+    if len(pad_rows):
+        np.testing.assert_allclose(
+            pad_rows - pad_rows[0][None], 0.0, atol=1e-6
+        )
+
+
+def test_trains_on_sbm(mesh8, sbm):
+    from dgraph_tpu.train.loop import fit, vmask_batch_args
+
+    g8 = build_graphs(sbm, 8)
+    comm8 = Communicator.init_process_group("tpu", world_size=8)
+    model = _model(comm8)
+    params, history = fit(
+        model, g8, mesh8, optimizer=optax.adam(3e-3), num_epochs=40,
+        batch_args=vmask_batch_args,
+    )
+    assert history[-1]["loss"] < history[0]["loss"] * 0.7
