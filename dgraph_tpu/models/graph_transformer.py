@@ -106,6 +106,14 @@ class GraphTransformer(nn.Module):
 
         dt = _cfg.resolve_compute_dtype(self.dtype)
         if vmask is None:
+            if getattr(self.comm, "graph_axis", None) is not None:
+                # distributed shards ALWAYS contain padded vertex slots;
+                # an all-ones default would let every real vertex attend to
+                # padding — silent logit corruption, so fail loudly
+                raise ValueError(
+                    "GraphTransformer requires vmask (DistributedGraph."
+                    "vertex_mask) in distributed mode"
+                )
             vmask = jnp.ones((x.shape[0],), jnp.float32)
         h = nn.Dense(self.latent, dtype=dt, name="embed")(x)
         h = h * vmask[:, None].astype(h.dtype)

@@ -25,6 +25,7 @@ checkpoint writes go through the lead process only (callers pass
 
 from __future__ import annotations
 
+import contextlib
 import os
 import signal
 import threading
@@ -120,22 +121,17 @@ class StepWatchdog:
         self._last = time.monotonic()
         self._beaten = True
 
+    @contextlib.contextmanager
     def suspended(self):
         """Context manager: pause expiry (e.g. around checkpoint saves —
         a long orbax write is not a wedged device) and restart the clock
         on exit."""
-        import contextlib
-
-        @contextlib.contextmanager
-        def cm():
-            self._suspended = True
-            try:
-                yield
-            finally:
-                self._last = time.monotonic()
-                self._suspended = False
-
-        return cm()
+        self._suspended = True
+        try:
+            yield
+        finally:
+            self._last = time.monotonic()
+            self._suspended = False
 
     def _run(self) -> None:
         while not self._done.wait(min(self.deadline_s / 4, 5.0)):
@@ -191,8 +187,6 @@ def run_elastic(
 
     if start_step >= num_steps:  # nothing to do (e.g. resuming a finished run)
         return state, start_step, False
-    import contextlib
-
     own_guard = guard is None
     guard = guard or PreemptionGuard()
     dog = StepWatchdog(step_deadline_s) if step_deadline_s > 0 else None

@@ -36,7 +36,7 @@ class DataConfig:
 class Config:
     """Distributed full-graph GCN training."""
 
-    model: str = "gcn"  # gcn | sage | gat
+    model: str = "gcn"  # gcn | sage | gat | gt (GraphTransformer)
     hidden: int = 128
     num_layers: int = 2
     lr: float = 5e-3
@@ -112,8 +112,15 @@ def main(cfg: Config):
 
     from dgraph_tpu.comm import Communicator, make_graph_mesh
     from dgraph_tpu.data import DistributedGraph
-    from dgraph_tpu.models import GAT, GCN, GraphSAGE
-    from dgraph_tpu.train.loop import init_params, make_eval_step, make_train_step
+    from dgraph_tpu.models import GAT, GCN, GraphSAGE, GraphTransformer
+    from dgraph_tpu.train.loop import (
+        init_params,
+        make_eval_step,
+        make_train_step,
+        masked_bce_multilabel,
+        masked_cross_entropy,
+        vmask_batch_args,
+    )
     from dgraph_tpu.utils import ExperimentLog, TimingReport
 
     world = cfg.world_size or len(jax.devices())
@@ -140,23 +147,30 @@ def main(cfg: Config):
         model = GraphSAGE(cfg.hidden, C, comm=comm, num_layers=cfg.num_layers)
     elif cfg.model == "gat":
         model = GAT(cfg.hidden, C, comm=comm, num_layers=cfg.num_layers)
+    elif cfg.model in ("gt", "graph_transformer"):
+        model = GraphTransformer(cfg.hidden, C, comm=comm, num_layers=cfg.num_layers)
     else:
         raise SystemExit(f"unknown model {cfg.model}")
+    bargs = vmask_batch_args if cfg.model in ("gt", "graph_transformer") else None
 
     plan = jax.tree.map(jnp.asarray, g.plan)
-    batch_tr = jax.tree.map(jnp.asarray, dict(g.batch("train"), y=g.labels))
-    batch_va = jax.tree.map(jnp.asarray, dict(g.batch("val"), y=g.labels))
+    batch_tr = jax.tree.map(
+        jnp.asarray, dict(g.batch("train"), y=g.labels, vmask=g.vertex_mask)
+    )
+    batch_va = jax.tree.map(
+        jnp.asarray, dict(g.batch("val"), y=g.labels, vmask=g.vertex_mask)
+    )
 
-    params = init_params(model, mesh, plan, batch_tr)
+    params = init_params(model, mesh, plan, batch_tr, batch_args=bargs)
     optimizer = optax.adam(cfg.lr)
     opt_state = optimizer.init(params)
-    from dgraph_tpu.train.loop import masked_bce_multilabel, masked_cross_entropy
-
     loss_fn = (
         masked_bce_multilabel if np.asarray(g.labels).ndim > 2 else masked_cross_entropy
     )
-    train_step = make_train_step(model, optimizer, mesh, plan, loss_fn=loss_fn)
-    eval_step = make_eval_step(model, mesh, loss_fn=loss_fn)
+    train_step = make_train_step(
+        model, optimizer, mesh, plan, loss_fn=loss_fn, batch_args=bargs
+    )
+    eval_step = make_eval_step(model, mesh, loss_fn=loss_fn, batch_args=bargs)
     log = ExperimentLog(cfg.log_path)
 
     epoch_times = []
@@ -181,7 +195,9 @@ def main(cfg: Config):
     # final held-out accuracy (the reference reports test accuracy for the
     # OGB runs; ~72% is the public GCN bar on real ogbn-arxiv)
     if "test" in g.masks:
-        batch_te = jax.tree.map(jnp.asarray, dict(g.batch("test"), y=g.labels))
+        batch_te = jax.tree.map(
+            jnp.asarray, dict(g.batch("test"), y=g.labels, vmask=g.vertex_mask)
+        )
         with jax.set_mesh(mesh):
             te = eval_step(params, batch_te, plan)
         log.write({"test_acc": float(te["accuracy"]), "test_loss": float(te["loss"])})
