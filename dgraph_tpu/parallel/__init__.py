@@ -27,6 +27,7 @@ from dgraph_tpu.parallel.sequence import (
     dense_attention,
     ring_attention,
     ring_attention_sharded,
+    ulysses_attention,
 )
 from dgraph_tpu.comm import collectives
 from dgraph_tpu.comm.collectives import (
@@ -50,6 +51,7 @@ __all__ = [
     "dense_attention",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
     "collectives",
     "gather",
     "gather_concat",

@@ -160,6 +160,58 @@ def dense_attention(
     return out.astype(q.dtype)
 
 
+def ulysses_attention(
+    q: jax.Array,  # [T_loc, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    kv_mask: Optional[jax.Array] = None,  # [T_loc]
+) -> jax.Array:
+    """All-to-all sequence parallelism (the DeepSpeed-Ulysses layout swap):
+    one ``all_to_all`` re-shards [T/W, H, D] -> [T, H/W, D] (full sequence,
+    subset of heads), dense attention runs per head with NO inner-loop
+    communication, and a second ``all_to_all`` restores sequence sharding.
+
+    vs the ring: 2 big collectives + O(T) memory/device instead of W
+    neighbor hops + O(T/W) memory. The ring wins at long context (memory)
+    and maps onto ICI neighbor links; Ulysses wins when heads are plentiful
+    and T fits — both are exact. Requires H divisible by the axis size.
+    Same contract as :func:`ring_attention` (call inside shard_map,
+    contiguous-block sequence sharding).
+    """
+    H = q.shape[1]
+    W = lax.psum(1, axis_name)  # static (mesh axis size)
+    if H % W:
+        raise ValueError(
+            f"ulysses_attention needs heads ({H}) divisible by the "
+            f"{axis_name!r} axis size ({W}); use ring_attention otherwise"
+        )
+
+    def seq_to_head(x):  # [T_loc, H, D] -> [W*T_loc, H/W, D]
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=0, tiled=True
+        )
+
+    def head_to_seq(x):  # [W*T_loc, H/W, D] -> [T_loc, H, D]
+        return lax.all_to_all(
+            x, axis_name, split_axis=0, concat_axis=1, tiled=True
+        )
+
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    if kv_mask is None:
+        mask_full = None
+    else:
+        # every device needs the FULL-sequence mask once heads are sharded
+        mask_full = lax.all_gather(kv_mask, axis_name, tiled=True)
+    out = dense_attention(
+        qh, kh, vh, causal=causal, scale=scale, kv_mask=mask_full
+    )
+    return head_to_seq(out)
+
+
 def ring_attention_sharded(
     q: jax.Array,  # [T, H, D] FULL sequence (host/global view)
     k: jax.Array,

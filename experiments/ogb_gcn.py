@@ -154,12 +154,14 @@ def main(cfg: Config):
     bargs = vmask_batch_args if cfg.model in ("gt", "graph_transformer") else None
 
     plan = jax.tree.map(jnp.asarray, g.plan)
-    batch_tr = jax.tree.map(
-        jnp.asarray, dict(g.batch("train"), y=g.labels, vmask=g.vertex_mask)
-    )
-    batch_va = jax.tree.map(
-        jnp.asarray, dict(g.batch("val"), y=g.labels, vmask=g.vertex_mask)
-    )
+
+    def _batch(split):
+        return jax.tree.map(
+            jnp.asarray, dict(g.batch(split), y=g.labels, vmask=g.vertex_mask)
+        )
+
+    batch_tr = _batch("train")
+    batch_va = _batch("val")
 
     params = init_params(model, mesh, plan, batch_tr, batch_args=bargs)
     optimizer = optax.adam(cfg.lr)
@@ -195,9 +197,7 @@ def main(cfg: Config):
     # final held-out accuracy (the reference reports test accuracy for the
     # OGB runs; ~72% is the public GCN bar on real ogbn-arxiv)
     if "test" in g.masks:
-        batch_te = jax.tree.map(
-            jnp.asarray, dict(g.batch("test"), y=g.labels, vmask=g.vertex_mask)
-        )
+        batch_te = _batch("test")
         with jax.set_mesh(mesh):
             te = eval_step(params, batch_te, plan)
         log.write({"test_acc": float(te["accuracy"]), "test_loss": float(te["loss"])})
