@@ -260,6 +260,43 @@ def scatter_sum(
     )
 
 
+@_scoped("dgraph.scatter_bias_relu")
+def scatter_bias_relu(
+    edata: jax.Array,  # [e_pad, F] per-edge stream (e.g. gathered src proj)
+    bias: jax.Array,  # [n_pad, F] owner-side vertex operand
+    plan: EdgePlan,
+    side: str,
+    axis_name: Optional[str],
+    edge_weight: Optional[jax.Array] = None,  # [e_pad]
+) -> jax.Array:
+    """Fused owner-side aggregation: out[v] = Σ_e w_e · relu(edata_e + bias_v).
+
+    Parity: the reference's fused scatter kernels
+    (``Fused_ReLU_Scatter_Kernel`` / ``Fused_Sum_Norm_Scatter_Kernel``,
+    ``local_data_kernels.cuh:34-116``). On TPU the fusion must live INSIDE
+    the Pallas kernel (``pallas_call`` is an XLA fusion barrier, so the
+    composed path materializes the [E, F] message tensor in HBM); off-TPU
+    (or non-owner side) it falls back to the exact composed ops.
+    """
+    idx = _side_index(plan, side)
+    n_pad = _side_npad(plan, side)
+    # one compute dtype on both paths: the kernel runs bias at edata's
+    # precision, so the fallback must too (cross-backend equivalence)
+    bias = bias.astype(edata.dtype)
+    if side != plan.halo_side and plan.owner_sorted:
+        # owner side: shared Pallas-or-jnp dispatch (kill switch + precision
+        # policy in ONE place — ops.local)
+        return local_ops.sorted_segment_sum_bias_relu_any(
+            edata, idx, bias, n_pad,
+            plan.scatter_block_e, plan.scatter_block_n, plan.scatter_mc,
+            edge_weight=edge_weight,
+        )
+    m = jax.nn.relu(edata + gather(bias, plan, side, axis_name))
+    if edge_weight is not None:
+        m = m * edge_weight[:, None].astype(m.dtype)
+    return scatter_sum(m, plan, side, axis_name)
+
+
 @_scoped("dgraph.gather_concat")
 def gather_concat(
     x_src: jax.Array,

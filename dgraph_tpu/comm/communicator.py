@@ -71,6 +71,14 @@ class _BaseComm:
 
     scatter_sum = scatter
 
+    def scatter_bias_relu(self, edata, bias, plan: EdgePlan, side: str = "dst",
+                          edge_weight=None):
+        """Fused Σ w·relu(edata + bias[owner]) aggregation (the reference's
+        fused scatter kernel family; Pallas on TPU, composed ops elsewhere)."""
+        return collectives.scatter_bias_relu(
+            edata, bias, plan, side, self.graph_axis, edge_weight
+        )
+
     def put(self, send: jax.Array) -> jax.Array:
         """Deliver per-peer blocks by offsets — the ``BackendEngine.put``
         contract (``Engine.py:67-86``): two-sided backends alltoallv the

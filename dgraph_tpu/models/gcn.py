@@ -28,9 +28,12 @@ from dgraph_tpu.plan import EdgePlan
 class GraphConvLayer(nn.Module):
     """concat(src, dst) -> Dense -> activation -> scatter-sum to `aggregate_to`.
 
-    Parity: ``experiments/OGB/GCN.py:28-67`` (which fuses the ReLU into the
-    CUDA scatter kernel, ``local_data_kernels.cuh:34-72``; here XLA fuses the
-    elementwise chain into the segment reduction automatically).
+    Parity: ``experiments/OGB/GCN.py:28-67``, which fuses the ReLU into the
+    CUDA scatter kernel (``local_data_kernels.cuh:34-72``). Here that fusion
+    lives inside the Pallas kernel too (``pallas_call`` is an XLA fusion
+    barrier, so without it the [E, F] message tensor round-trips HBM):
+    relu default + owner-side aggregation takes the fused
+    ``scatter_bias_relu`` path below.
     """
 
     out_features: int
@@ -56,6 +59,25 @@ class GraphConvLayer(nn.Module):
         dt = _cfg.resolve_compute_dtype(self.dtype)
         h_s = nn.Dense(self.out_features, name="src_proj", dtype=dt)(x)
         h_d = nn.Dense(self.out_features, use_bias=False, name="dst_proj", dtype=dt)(x)
+        # fused path (relu + owner-side aggregation, homogeneous plans):
+        # the owner-side projection rides into the scatter kernel as a
+        # per-vertex-block bias, so the [E, F] message tensor never exists
+        # (collectives.scatter_bias_relu; falls back to composed ops
+        # off-TPU — same math, pinned by the equivalence tests)
+        if (
+            self.activation is nn.relu
+            and plan.homogeneous
+            and self.aggregate_to != plan.halo_side
+        ):
+            owner, stream = self.aggregate_to, (
+                "src" if self.aggregate_to == "dst" else "dst"
+            )
+            h_bias = h_d if owner == "dst" else h_s
+            h_stream = h_s if owner == "dst" else h_d
+            e_stream = self.comm.gather(h_stream, plan, side=stream)
+            return self.comm.scatter_bias_relu(
+                e_stream, h_bias, plan, side=owner, edge_weight=edge_weight
+            )
         m = self.comm.gather(h_s, plan, side="src") + self.comm.gather(
             h_d, plan, side="dst"
         )

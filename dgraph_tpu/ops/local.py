@@ -160,6 +160,30 @@ def sorted_segment_sum_any(data, sorted_ids, n_rows, be, bn, mc):
     return segment_sum(data, sorted_ids, n_rows, indices_are_sorted=True)
 
 
+def sorted_segment_sum_bias_relu_any(
+    edata, sorted_ids, bias, n_rows, be, bn, mc, edge_weight=None,
+):
+    """Fused Σ w·relu(edata + bias[id]) for sorted ids — Pallas on TPU
+    (``ops.pallas_segment.sorted_segment_sum_bias_relu``), composed jnp ops
+    elsewhere. Same single-dispatch-point contract as
+    :func:`sorted_segment_sum_any`: kill switch + precision policy live
+    HERE, not at call sites."""
+    from dgraph_tpu import config as _cfg
+
+    if _cfg.pallas_scatter_enabled() and jax.default_backend() == "tpu":
+        from dgraph_tpu.ops.pallas_segment import sorted_segment_sum_bias_relu
+
+        prec = "default" if edata.dtype == jnp.bfloat16 else "highest"
+        return sorted_segment_sum_bias_relu(
+            edata, sorted_ids, bias, n_rows, edge_weight=edge_weight,
+            max_chunks_per_block=mc, block_e=be, block_n=bn, precision=prec,
+        )
+    m = jax.nn.relu(edata + row_take(bias, sorted_ids, oob="fill"))
+    if edge_weight is not None:
+        m = m * edge_weight[:, None].astype(m.dtype)
+    return segment_sum(m, sorted_ids, n_rows, indices_are_sorted=True)
+
+
 @functools.lru_cache(maxsize=None)
 def _make_take_rows_sortroute(n_rows, col_block, be, bn, mc):
     """Row gather for UNSORTED ids whose VJP still runs the sorted fast

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import math
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -73,6 +74,81 @@ def _kernel(
         )
 
 
+class _ChunkSchedule:
+    """Shared scaffold of the sorted-CSR kernels: pad edges to chunk
+    multiples, compute per-vertex-block chunk ranges (in-jit searchsorted;
+    ids sorted), and hand out BlockSpecs over the (nb, max_chunks) grid.
+
+    Iterations past counts[b] clamp to the block's LAST VALID chunk: Mosaic
+    skips the DMA when consecutive grid steps map to the same block index,
+    so the padded tail of the grid costs no HBM traffic (each kernel's
+    @pl.when guard skips its compute).
+
+    ids are carried as [num_chunks, 1, block_e]: Mosaic requires the last
+    two block dims to be (8,128)-tileable OR equal to the array dims — a
+    (1, block_e) block over [num_chunks, block_e] violates the sublane rule
+    on real TPU (interpret mode doesn't check), so the explicit singleton
+    sublane dim IS the full array dim.
+    """
+
+    def __init__(self, segment_ids, num_segments, E, *, block_e, block_n,
+                 max_chunks_per_block):
+        self.block_e, self.block_n = block_e, block_n
+        self.max_chunks = max_chunks_per_block
+        self.E_pad = pl.cdiv(E, block_e) * block_e
+        self.N_pad = pl.cdiv(num_segments, block_n) * block_n
+        self.num_chunks = self.E_pad // block_e
+        self.nb = self.N_pad // block_n
+        if self.E_pad != E:
+            segment_ids = jnp.pad(
+                segment_ids, (0, self.E_pad - E), constant_values=num_segments + 1
+            )
+        self.ids = segment_ids
+        self.ids3d = segment_ids.reshape(self.num_chunks, 1, block_e)
+        starts = jnp.searchsorted(segment_ids, jnp.arange(self.nb) * block_n)
+        ends = jnp.searchsorted(
+            segment_ids, jnp.arange(1, self.nb + 1) * block_n, side="left"
+        )
+        self.chunk_start = (starts // block_e).astype(jnp.int32)
+        self.chunk_counts = jnp.minimum(
+            pl.cdiv(ends, block_e).astype(jnp.int32) - self.chunk_start,
+            max_chunks_per_block,
+        ).astype(jnp.int32)
+
+    def pad_edges(self, arr):
+        """Pad an [E, ...] per-edge operand to E_pad rows."""
+        pad = self.E_pad - arr.shape[0]
+        if pad:
+            arr = jnp.pad(arr, ((0, pad),) + ((0, 0),) * (arr.ndim - 1))
+        return arr
+
+    def chunk_spec(self, block_shape):
+        """BlockSpec streaming a per-chunk operand ([num_chunks, ...])."""
+        num_chunks = self.num_chunks
+
+        def index(b, k, starts, counts):
+            return (
+                jnp.minimum(
+                    starts[b]
+                    + jnp.minimum(k, jnp.maximum(counts[b] - 1, 0)),
+                    num_chunks - 1,
+                ),
+            ) + (0,) * (len(block_shape) - 1)
+
+        return pl.BlockSpec(block_shape, index)
+
+    def block_spec(self, F):
+        """BlockSpec for an [N_pad, F] owner-side operand/output."""
+        return pl.BlockSpec((self.block_n, F), lambda b, k, s, c: (b, 0))
+
+
+def _precision(precision: str):
+    return (
+        jax.lax.Precision.HIGHEST if precision == "highest"
+        else jax.lax.Precision.DEFAULT
+    )
+
+
 def _sorted_segment_sum_impl(
     data, segment_ids, num_segments, *, max_chunks_per_block, block_e, block_n,
     interpret, input_op, precision,
@@ -80,60 +156,21 @@ def _sorted_segment_sum_impl(
     if input_op not in ("none", "relu"):
         raise ValueError(f"input_op must be 'none' or 'relu', got {input_op!r}")
     E, F = data.shape
-    E_pad = pl.cdiv(E, block_e) * block_e
-    N_pad = pl.cdiv(num_segments, block_n) * block_n
-    num_chunks = E_pad // block_e
-    nb = N_pad // block_n
-    if E_pad != E:
-        pad = E_pad - E
-        data = jnp.pad(data, ((0, pad), (0, 0)))
-        segment_ids = jnp.pad(segment_ids, (0, pad), constant_values=num_segments + 1)
-
-    # ids as [num_chunks, 1, block_e]: Mosaic requires the last two block
-    # dims to be (8,128)-tileable OR equal to the array dims — a (1, block_e)
-    # block over a [num_chunks, block_e] array violates the sublane rule on
-    # real TPU (interpret mode doesn't check), so carry an explicit
-    # singleton sublane dim that IS the full array dim.
-    ids3d = segment_ids.reshape(num_chunks, 1, block_e)
-    data3d = data.reshape(num_chunks, block_e, F)
-
-    # per-vertex-block chunk ranges (in-jit; ids sorted)
-    block_edges_start = jnp.searchsorted(segment_ids, jnp.arange(nb) * block_n)
-    block_edges_end = jnp.searchsorted(
-        segment_ids, jnp.arange(1, nb + 1) * block_n, side="left"
+    sched = _ChunkSchedule(
+        segment_ids, num_segments, E, block_e=block_e, block_n=block_n,
+        max_chunks_per_block=max_chunks_per_block,
     )
-    chunk_start = (block_edges_start // block_e).astype(jnp.int32)
-    chunk_end = (pl.cdiv(block_edges_end, block_e)).astype(jnp.int32)
-    chunk_counts = jnp.minimum(chunk_end - chunk_start, max_chunks_per_block).astype(
-        jnp.int32
-    )
-
-    # Iterations past counts[b] clamp to the block's LAST VALID chunk:
-    # Mosaic skips the DMA when consecutive grid steps map to the same block
-    # index, so the padded tail of the (nb, max_chunks) grid costs no HBM
-    # traffic (the @pl.when guard already skips its compute).
-    def _chunk_index(b, k, starts, counts):
-        return jnp.minimum(
-            starts[b] + jnp.minimum(k, jnp.maximum(counts[b] - 1, 0)),
-            num_chunks - 1,
-        )
+    data3d = sched.pad_edges(data).reshape(sched.num_chunks, block_e, F)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(nb, max_chunks_per_block),
+        grid=(sched.nb, sched.max_chunks),
         in_specs=[
-            pl.BlockSpec(
-                (1, 1, block_e),
-                lambda b, k, starts, counts: (_chunk_index(b, k, starts, counts), 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, block_e, F),
-                lambda b, k, starts, counts: (_chunk_index(b, k, starts, counts), 0, 0),
-            ),
+            sched.chunk_spec((1, 1, block_e)),
+            sched.chunk_spec((1, block_e, F)),
         ],
-        out_specs=pl.BlockSpec((block_n, F), lambda b, k, starts, counts: (b, 0)),
+        out_specs=sched.block_spec(F),
     )
-    prec = jax.lax.Precision.HIGHEST if precision == "highest" else jax.lax.Precision.DEFAULT
     # The MXU accumulator must be 32-bit ('tpu.matmul' rejects a bf16 acc),
     # and f32 accumulation over long segments is the atomicAdd-parity
     # semantics anyway — so the VMEM-resident output block is ALWAYS f32
@@ -141,12 +178,13 @@ def _sorted_segment_sum_impl(
     # precision='default'); cast back to the input dtype on the way out.
     out = pl.pallas_call(
         functools.partial(
-            _kernel, block_n=block_n, block_e=block_e, input_op=input_op, precision=prec
+            _kernel, block_n=block_n, block_e=block_e, input_op=input_op,
+            precision=_precision(precision),
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((N_pad, F), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((sched.N_pad, F), jnp.float32),
         interpret=interpret,
-    )(chunk_start, chunk_counts, ids3d, data3d)
+    )(sched.chunk_start, sched.chunk_counts, sched.ids3d, data3d)
     return out[:num_segments].astype(data.dtype)
 
 
@@ -214,6 +252,172 @@ def sorted_segment_sum(
         num_segments, max_chunks_per_block, block_e, block_n, interpret,
         input_op, precision,
     )(data, segment_ids)
+
+
+def _kernel_bias_relu(
+    starts_ref, counts_ref, ids_ref, *refs,
+    block_n, block_e, precision, has_weight,
+):
+    """out[v] += sum_e onehot[e,v] * w[e] * relu(data[e] + bias[v]).
+
+    The bias lookup bias[ids[e]] is itself a one-hot matmul against the
+    block's resident bias tile — per-edge rows of the OWNER-side vertex
+    operand never touch HBM. This is the full TPU analogue of the
+    reference's fused scatter family (``Fused_ReLU_Scatter_Kernel`` /
+    ``Fused_Sum_Norm_Scatter_Kernel``, ``local_data_kernels.cuh:34-116``):
+    XLA alone cannot do it because ``pallas_call`` is a fusion barrier, so
+    the [E, F] message tensor would round-trip HBM.
+    """
+    if has_weight:
+        wgt_ref, data_ref, bias_ref, out_ref = refs
+    else:
+        (data_ref, bias_ref, out_ref), wgt_ref = refs, None
+    b = pl.program_id(0)
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(k < counts_ref[b])
+    def _accumulate():
+        ids = ids_ref[0, 0]  # [block_e]
+        chunk = data_ref[0]  # [block_e, F]
+        rel2 = (ids - b * block_n)[:, None]
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_e, block_n), 1)
+        onehot = jnp.where(
+            (cols == rel2) & (rel2 >= 0) & (rel2 < block_n), 1.0, 0.0
+        ).astype(chunk.dtype)
+        # bias[ids[e]] for in-block edges (OOB rows get 0 — they're dropped
+        # by the output contraction anyway)
+        bias_rows = jax.lax.dot_general(
+            onehot, bias_ref[...].astype(chunk.dtype),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        in_dtype = data_ref.dtype
+        chunk = jnp.maximum(chunk.astype(jnp.float32) + bias_rows, 0)
+        if has_weight:
+            chunk = chunk * wgt_ref[0, 0][:, None].astype(jnp.float32)
+        # back to the input dtype for the contraction (bf16 inputs keep the
+        # fast MXU passes; matches the unfused path where m was bf16)
+        chunk = chunk.astype(in_dtype)
+        out_ref[...] += jax.lax.dot_general(
+            onehot,
+            chunk,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=out_ref.dtype,
+            precision=precision,
+        )
+
+
+@functools.lru_cache(maxsize=None)
+def _make_ssbr(num_segments, max_chunks_per_block, block_e, block_n, interpret,
+               precision, has_weight):
+    def impl(data, segment_ids, bias, edge_weight):
+        E, F = data.shape
+        sched = _ChunkSchedule(
+            segment_ids, num_segments, E, block_e=block_e, block_n=block_n,
+            max_chunks_per_block=max_chunks_per_block,
+        )
+        data3d = sched.pad_edges(data).reshape(sched.num_chunks, block_e, F)
+        if sched.N_pad != num_segments:
+            bias = jnp.pad(bias, ((0, sched.N_pad - num_segments), (0, 0)))
+        in_specs = [
+            sched.chunk_spec((1, 1, block_e)),
+            sched.chunk_spec((1, block_e, F)),
+            sched.block_spec(F),
+        ]
+        operands = [sched.ids3d, data3d, bias]
+        if has_weight:
+            wgt3d = sched.pad_edges(edge_weight).reshape(
+                sched.num_chunks, 1, block_e
+            )
+            in_specs.insert(1, sched.chunk_spec((1, 1, block_e)))
+            operands.insert(1, wgt3d)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(sched.nb, sched.max_chunks),
+            in_specs=in_specs,
+            out_specs=sched.block_spec(F),
+        )
+        out = pl.pallas_call(
+            functools.partial(
+                _kernel_bias_relu, block_n=block_n, block_e=block_e,
+                precision=_precision(precision), has_weight=has_weight,
+            ),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((sched.N_pad, F), jnp.float32),
+            interpret=interpret,
+        )(sched.chunk_start, sched.chunk_counts, *operands)
+        return out[:num_segments].astype(data.dtype)
+
+    @jax.custom_vjp
+    def f(data, segment_ids, bias, edge_weight):
+        return impl(data, segment_ids, bias, edge_weight)
+
+    def fwd(data, segment_ids, bias, edge_weight):
+        return impl(data, segment_ids, bias, edge_weight), (
+            data, segment_ids, bias, edge_weight,
+        )
+
+    def bwd(res, g):
+        data, segment_ids, bias, edge_weight = res
+        from dgraph_tpu.ops.local import row_take
+
+        # recompute the activation mask (remat: the [E,F] pre-activation
+        # was never materialized in the forward — that's the point)
+        pre = data.astype(jnp.float32) + row_take(
+            bias.astype(jnp.float32), segment_ids, oob="fill"
+        )
+        act = (pre > 0).astype(jnp.float32)
+        g_rows = row_take(g.astype(jnp.float32), segment_ids, oob="fill")
+        w = edge_weight[:, None].astype(jnp.float32) if has_weight else 1.0
+        gd = g_rows * act * w  # d/d(data)
+        # d/d(bias[v]) = g[v] * sum_e w_e*act_e  (sorted ids -> fast path)
+        from dgraph_tpu.ops.local import sorted_segment_sum_any
+
+        d_bias = sorted_segment_sum_any(
+            act * w, segment_ids, num_segments, block_e, block_n,
+            max_chunks_per_block,
+        ) * g.astype(jnp.float32)
+        if has_weight:
+            d_w = (g_rows * jnp.maximum(pre, 0)).sum(axis=-1).astype(
+                edge_weight.dtype
+            )
+        else:
+            d_w = jnp.zeros_like(edge_weight)
+        return gd.astype(data.dtype), None, d_bias.astype(bias.dtype), d_w
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def sorted_segment_sum_bias_relu(
+    data: jax.Array,  # [E, F] per-edge partial messages (e.g. gathered src proj)
+    segment_ids: jax.Array,  # [E] int32 MONOTONE owner-side ids
+    bias: jax.Array,  # [num_segments, F] owner-side vertex operand
+    num_segments: int,
+    *,
+    edge_weight: Optional[jax.Array] = None,  # [E] post-activation scale
+    max_chunks_per_block: int,
+    block_e: int = 512,
+    block_n: int = 256,
+    interpret: bool = False,
+    precision: str = "default",
+) -> jax.Array:
+    """out[v] = Σ_{e: ids[e]=v} w[e] * relu(data[e] + bias[v]) without ever
+    materializing the [E, F] message tensor in HBM (see
+    :func:`_kernel_bias_relu`). Differentiable (remat-style VJP)."""
+    has_w = edge_weight is not None
+    fn = _make_ssbr(
+        num_segments, max_chunks_per_block, block_e, block_n, interpret,
+        precision, has_w,
+    )
+    if not has_w:
+        edge_weight = jnp.zeros((data.shape[0],), data.dtype)
+    return fn(data, segment_ids, bias, edge_weight)
 
 
 def max_chunks_hint(
