@@ -91,6 +91,30 @@ def _timed_scan_ms(epochs_fn, state, n_long, reps=3, max_rounds=6):
     return median, state
 
 
+# One dtype/precision/tolerance table for EVERY chip self-check: f32/highest
+# (atomicAdd-parity path) AND bf16/default (the dtype+precision the bf16
+# training VJPs actually emit — a Mosaic acc-dtype bug is invisible to the
+# f32 check alone, seen r2). Resolved lazily (jnp import).
+def _selfcheck_cases():
+    import jax.numpy as jnp
+
+    return [(jnp.float32, "highest", 1e-4), (jnp.bfloat16, "default", 5e-2)]
+
+
+def _check_one(label: str, run, ref, tol) -> bool:
+    """Shared try/compare/log verdict for a chip self-check case."""
+    import numpy as np
+
+    try:
+        got = np.asarray(run())
+        ok = bool(np.allclose(got, ref, rtol=tol, atol=tol))
+    except Exception as e:  # Mosaic compile failure = exactly what we gate on
+        log(f"self-check {label} raised {type(e).__name__}: {e}")
+        ok = False
+    log(f"self-check on chip {label}: {'OK' if ok else 'FAILED'}")
+    return ok
+
+
 def pallas_selfcheck() -> bool:
     """Chip-gated Pallas correctness check (VERDICT r1 weak #3): the Mosaic
     lowering class of bug is invisible to the interpret-mode CI tests, so
@@ -115,28 +139,68 @@ def pallas_selfcheck() -> bool:
     from dgraph_tpu.plan import SCATTER_BLOCK_E, SCATTER_BLOCK_N
 
     configs = {(512, 256), (SCATTER_BLOCK_E, SCATTER_BLOCK_N)}
-    # f32/highest (atomicAdd-parity path) AND bf16/default (the dtype+precision
-    # the bf16 training VJPs actually emit — a Mosaic acc-dtype bug is
-    # invisible to the f32 check alone, seen r2)
-    cases = [(jnp.float32, "highest", 1e-4), (jnp.bfloat16, "default", 5e-2)]
     for be, bn in sorted(configs):
-        for dt, prec, tol in cases:
-            try:
-                got = np.asarray(
-                    sorted_segment_sum(
-                        jnp.asarray(data, dt), jnp.asarray(ids), N,
-                        max_chunks_per_block=max_chunks_hint(ids, N, block_e=be, block_n=bn),
-                        block_e=be, block_n=bn, precision=prec,
-                    ).astype(jnp.float32)
-                )
-                this_ok = bool(np.allclose(got, want, rtol=tol, atol=tol))
-            except Exception as e:  # Mosaic compile failure = exactly what we gate on
-                log(f"pallas self-check (be={be},bn={bn},{dt.__name__}) raised "
-                    f"{type(e).__name__}: {e}")
-                this_ok = False
-            log(f"pallas self-check on chip (be={be},bn={bn},{dt.__name__}): "
-                f"{'OK' if this_ok else 'FAILED'}")
-            ok = ok and this_ok
+        for dt, prec, tol in _selfcheck_cases():
+            ok &= _check_one(
+                f"scatter(be={be},bn={bn},{dt.__name__})",
+                lambda dt=dt, prec=prec, be=be, bn=bn: sorted_segment_sum(
+                    jnp.asarray(data, dt), jnp.asarray(ids), N,
+                    max_chunks_per_block=max_chunks_hint(
+                        ids, N, block_e=be, block_n=bn
+                    ),
+                    block_e=be, block_n=bn, precision=prec,
+                ).astype(jnp.float32),
+                want, tol,
+            )
+    return ok
+
+
+def pallas_fused_selfcheck() -> bool:
+    """Same chip gate for the FUSED bias+relu scatter kernel (its own kill
+    switch: a Mosaic regression here must not also disable the plain one)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return False
+    from dgraph_tpu.ops.pallas_segment import (
+        max_chunks_hint,
+        sorted_segment_sum_bias_relu,
+    )
+    from dgraph_tpu.plan import SCATTER_BLOCK_E, SCATTER_BLOCK_N
+
+    rng = np.random.default_rng(11)
+    E, N, F = 8192, 2048, 128
+    ids = np.sort(rng.integers(0, N, E)).astype(np.int32)
+    ids[-64:] = N + 1  # padded-edge tail
+    data = rng.standard_normal((E, F)).astype(np.float32)
+    bias = rng.standard_normal((N, F)).astype(np.float32)
+    w = rng.uniform(0.5, 2.0, E).astype(np.float32)
+    want = np.zeros((N, F), np.float32)
+    wantw = np.zeros((N, F), np.float32)
+    for e in range(E):
+        if ids[e] >= N:
+            continue
+        m = np.maximum(data[e] + bias[ids[e]], 0)
+        want[ids[e]] += m
+        wantw[ids[e]] += w[e] * m
+    ok = True
+    be, bn = SCATTER_BLOCK_E, SCATTER_BLOCK_N
+    mc = max_chunks_hint(ids, N, block_e=be, block_n=bn)
+    for dt, prec, tol in _selfcheck_cases():
+        for use_w, ref in [(False, want), (True, wantw)]:
+            ok &= _check_one(
+                f"fused-bias-relu({dt.__name__},w={use_w})",
+                lambda dt=dt, prec=prec, use_w=use_w: sorted_segment_sum_bias_relu(
+                    jnp.asarray(data, dt), jnp.asarray(ids),
+                    jnp.asarray(bias, dt), N,
+                    edge_weight=jnp.asarray(w, dt) if use_w else None,
+                    max_chunks_per_block=mc, block_e=be, block_n=bn,
+                    precision=prec,
+                ).astype(jnp.float32),
+                ref, tol,
+            )
     return ok
 
 
@@ -381,6 +445,17 @@ def main():
     # the tri-state env already — don't re-parse with different semantics).
     want_pallas = cfg.use_pallas_scatter is not False
     cfg.set_flags(use_pallas_scatter=want_pallas and pallas_selfcheck())
+    # fused kernel: genuinely independent kill switch. Enabled when the env
+    # pins it ON (even with plain scatter off — the A/B-the-fused-alone
+    # case) or, in auto mode, when the plain kernel is on; either way the
+    # chip self-check has the final veto.
+    if cfg.use_pallas_fused is False:
+        fused_wanted = False
+    elif cfg.use_pallas_fused is True:
+        fused_wanted = True
+    else:  # auto: follow the plain-scatter decision
+        fused_wanted = cfg.use_pallas_scatter
+    cfg.set_flags(use_pallas_fused=fused_wanted and pallas_fused_selfcheck())
 
     dt_ms, roof = bench_gcn(dtype_name)
     log(f"gcn epoch time {dt_ms:.2f} ms {roof}")
@@ -416,6 +491,7 @@ def main():
         "config": {
             "dtype": dtype_name,
             "pallas_scatter": cfg.use_pallas_scatter,
+            "pallas_fused": cfg.use_pallas_fused,
         },
         "wall_s": round(time.time() - t_start, 1),
     }

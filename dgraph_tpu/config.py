@@ -32,6 +32,19 @@ def pallas_scatter_enabled() -> bool:
 
     return jax.default_backend() == "tpu"
 
+
+# The FUSED bias+relu scatter kernel gets its own kill switch (tri-state;
+# None = follow the plain-scatter decision): a Mosaic regression in one
+# kernel must be disablable without losing the other (bench's self-check
+# sets these independently).
+use_pallas_fused: bool | None = _env_flag("DGRAPH_TPU_PALLAS_FUSED", None)
+
+
+def pallas_fused_enabled() -> bool:
+    if use_pallas_fused is not None:
+        return use_pallas_fused
+    return pallas_scatter_enabled()
+
 # Compute dtype for model matmuls (bfloat16 keeps the MXU fed; params stay
 # float32). Models resolve dtype=None through resolve_compute_dtype(), so
 # DGRAPH_TPU_COMPUTE_DTYPE=bfloat16 flips every model at once.

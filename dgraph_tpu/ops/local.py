@@ -170,7 +170,7 @@ def sorted_segment_sum_bias_relu_any(
     HERE, not at call sites."""
     from dgraph_tpu import config as _cfg
 
-    if _cfg.pallas_scatter_enabled() and jax.default_backend() == "tpu":
+    if _cfg.pallas_fused_enabled() and jax.default_backend() == "tpu":
         from dgraph_tpu.ops.pallas_segment import sorted_segment_sum_bias_relu
 
         prec = "default" if edata.dtype == jnp.bfloat16 else "highest"
