@@ -5,9 +5,12 @@ from dgraph_tpu.models.gat import GATConv, GAT
 from dgraph_tpu.models.norm import DistributedBatchNorm
 from dgraph_tpu.models.rgat import RGAT, RGATLayer, RelationalAttention
 from dgraph_tpu.models.graph_transformer import GPSLayer, GraphTransformer
+from dgraph_tpu.models.transformer import SeqTransformerLM, TransformerBlock
 
 __all__ = [
     "GPSLayer",
+    "SeqTransformerLM",
+    "TransformerBlock",
     "GraphTransformer",
     "RGAT",
     "RGATLayer",

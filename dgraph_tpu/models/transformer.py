@@ -1,0 +1,79 @@
+"""Sequence transformer LM over a sequence-sharded mesh axis — the
+long-context training demonstrator for :mod:`dgraph_tpu.parallel.sequence`.
+
+Beyond-reference (the reference has no sequence models, SURVEY.md §2.5):
+this is the framework's long-context story made end-to-end trainable. The
+sequence dimension is sharded over a mesh axis exactly like graph vertices
+are; every attention layer runs EXACT causal attention over the full
+sequence via ring attention (K/V blocks streaming over ppermute, O(T/W)
+memory per device; the comm facade's ``seq_attention``, which is the dense
+oracle under a single-device comm). All other ops (LN, FFN, embedding,
+head) are token-local, so the ONLY communication per layer is the
+attention collective itself. The Ulysses all-to-all lowering
+(:func:`dgraph_tpu.parallel.sequence.ulysses_attention`) is available for
+hand-rolled blocks; this model uses the ring.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class TransformerBlock(nn.Module):
+    latent: int
+    num_heads: int
+    comm: Any  # _BaseComm: seq_attention routes ring/dense by mode
+    dtype: Any = None
+    causal: bool = True
+
+    @nn.compact
+    def __call__(self, x):  # [T_loc, L]
+        from dgraph_tpu import config as _cfg
+
+        dt = _cfg.resolve_compute_dtype(self.dtype)
+        L, Hh = self.latent, self.num_heads
+        if L % Hh:
+            raise ValueError(f"latent {L} not divisible by heads {Hh}")
+        dh = L // Hh
+        y = nn.LayerNorm(dtype=dt, name="ln_attn")(x)
+        qkv = nn.Dense(3 * L, dtype=dt, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        n = x.shape[0]
+        attn = self.comm.seq_attention(
+            q.reshape(n, Hh, dh), k.reshape(n, Hh, dh), v.reshape(n, Hh, dh),
+            causal=self.causal,
+        )
+        x = x + nn.Dense(L, dtype=dt, name="attn_out")(attn.reshape(n, L))
+        y = nn.LayerNorm(dtype=dt, name="ln_ffn")(x)
+        h = nn.silu(nn.Dense(4 * L, dtype=dt, name="ffn_up")(y))
+        return x + nn.Dense(L, dtype=dt, name="ffn_down")(h)
+
+
+class SeqTransformerLM(nn.Module):
+    """Token-in, next-token-logits-out causal LM. Per-shard inputs: this
+    shard's [T_loc] token ids plus its global position offset (rank *
+    T_loc) baked into the learned positional embedding lookup."""
+
+    vocab: int
+    latent: int
+    num_layers: int = 2
+    num_heads: int = 4
+    max_len: int = 4096
+    comm: Any = None
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, tokens, positions):  # [T_loc] int32, [T_loc] int32
+        h = nn.Embed(self.vocab, self.latent, name="tok_embed")(tokens)
+        h = h + nn.Embed(self.max_len, self.latent, name="pos_embed")(positions)
+        for i in range(self.num_layers):
+            h = TransformerBlock(
+                self.latent, self.num_heads, comm=self.comm,
+                dtype=self.dtype, name=f"block_{i}",
+            )(h)
+        h = nn.LayerNorm(name="ln_out")(h)
+        return nn.Dense(self.vocab, name="head")(h).astype(jnp.float32)

@@ -1,0 +1,118 @@
+"""Sequence-sharded causal LM: single-device vs 8-way ring equivalence and
+end-to-end training over the mesh (the long-context story, trainable)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dgraph_tpu.comm import Communicator
+from dgraph_tpu.models.transformer import SeqTransformerLM
+
+W = 8
+T, V, L = 128, 17, 32  # sequence length, vocab, latent
+
+
+def _mesh():
+    devs = jax.devices()
+    if len(devs) < W:
+        pytest.skip(f"need {W} devices")
+    return Mesh(np.array(devs[:W]), ("graph",))
+
+
+def _induction_batch(rng, T, V):
+    """Repeated random segment: tokens[t] = tokens[t - T//2] for t >= T//2,
+    so a causal model can learn to copy — loss must fall well below the
+    uniform baseline."""
+    half = rng.integers(1, V, T // 2)
+    return np.concatenate([half, half]).astype(np.int32)
+
+
+def test_distributed_logits_match_single():
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(_induction_batch(rng, T, V))
+    pos = jnp.arange(T, dtype=jnp.int32)
+
+    m1 = SeqTransformerLM(
+        vocab=V, latent=L, comm=Communicator.init_process_group("single"),
+        max_len=T,
+    )
+    params = m1.init(jax.random.key(0), toks, pos)
+    ref = m1.apply(params, toks, pos)
+
+    m8 = SeqTransformerLM(
+        vocab=V, latent=L,
+        comm=Communicator.init_process_group("tpu", world_size=W), max_len=T,
+    )
+
+    def body(tk, ps):
+        return m8.apply(params, tk, ps)
+
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(P("graph"), P("graph")),
+        out_specs=P("graph"),
+    )
+    with jax.set_mesh(mesh):
+        got = fn(toks, pos)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=3e-4, atol=3e-4
+    )
+
+
+def test_trains_on_induction_task():
+    mesh = _mesh()
+    rng = np.random.default_rng(1)
+    comm = Communicator.init_process_group("tpu", world_size=W)
+    model = SeqTransformerLM(vocab=V, latent=L, comm=comm, max_len=T)
+    pos = jnp.arange(T, dtype=jnp.int32)
+
+    def shard_loss(params, toks, pos):
+        logits = model.apply(params, toks, pos)
+        # next-token prediction within the shard (skip the last local
+        # position; boundary tokens are a (T_loc)^-1 fraction — fine for a
+        # smoke task)
+        logp = jax.nn.log_softmax(logits[:-1])
+        ll = jnp.take_along_axis(logp, toks[1:, None], axis=1)[:, 0]
+        return -jax.lax.psum(ll.sum(), "graph") / (T - W)
+
+    def loss_fn(params, toks):
+        fn = jax.shard_map(
+            lambda p, tk, ps: shard_loss(p, tk, ps),
+            mesh=mesh,
+            in_specs=(P(), P("graph"), P("graph")),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return fn(params, toks, pos)
+
+    toks0 = jnp.asarray(_induction_batch(rng, T, V))
+    with jax.set_mesh(mesh):
+        params = jax.shard_map(
+            lambda tk, ps: model.init(jax.random.key(0), tk, ps),
+            mesh=mesh, in_specs=(P("graph"), P("graph")), out_specs=P(),
+            check_vma=False,
+        )(toks0, pos)
+        opt = optax.adam(3e-3)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, toks):
+            l, g = jax.value_and_grad(loss_fn)(params, toks)
+            updates, opt_state = opt.update(g, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, l
+
+        # fixed sequence: memorization drives loss far below the uniform
+        # baseline quickly — the point is end-to-end gradient flow through
+        # the ring (scan + ppermute transposes), not generalization
+        losses = []
+        for i in range(80):
+            params, opt_state, l = step(params, opt_state, toks0)
+            losses.append(float(l))
+
+    uniform = np.log(V)
+    assert losses[-1] < losses[0] * 0.5
+    assert losses[-1] < uniform * 0.5, (losses[0], losses[-1], uniform)
