@@ -64,7 +64,8 @@ class GATConv(nn.Module):
 
         # local softmax over incoming edges of each dst vertex
         alpha = local_ops.segment_softmax(
-            logits, plan.dst_index, plan.n_dst_pad, plan.edge_mask
+            logits, plan.dst_index, plan.n_dst_pad, plan.edge_mask,
+            indices_are_sorted=plan.owner_sorted,
         )  # [e_pad, H]
         msg = (alpha[..., None] * h_src).reshape(-1, H * D)
         out = self.comm.scatter_sum(msg, plan, side="dst").reshape(-1, H, D)

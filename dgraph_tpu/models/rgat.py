@@ -54,7 +54,10 @@ class RelationalAttention(nn.Module):
         logits = (h_src * a_src).sum(-1) + (h_dst * a_dst).sum(-1)
         logits = nn.leaky_relu(logits, self.negative_slope)
         alpha = local_ops.segment_softmax(
-            logits, plan.dst_index, plan.n_dst_pad, plan.edge_mask
+            logits, plan.dst_index, plan.n_dst_pad, plan.edge_mask,
+            # dst ids are monotone only when dst is the OWNER side (a
+            # src-owned plan's dst_index is the halo-side numbering)
+            indices_are_sorted=plan.owner_sorted and plan.halo_side == "src",
         )
         msg = (alpha[..., None] * h_src).reshape(-1, H * D)
         out = self.comm.scatter_sum(msg, plan, side="dst")

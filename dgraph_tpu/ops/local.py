@@ -364,10 +364,14 @@ def sparse_scatter_add(dst: jax.Array, idx: jax.Array, src: jax.Array) -> jax.Ar
     return dst.at[idx].add(src, mode="drop")
 
 
-def segment_max(data: jax.Array, segment_ids: jax.Array, num_segments: int) -> jax.Array:
+def segment_max(data: jax.Array, segment_ids: jax.Array, num_segments: int,
+                indices_are_sorted: bool = False) -> jax.Array:
     """Per-segment max (for attention softmax stabilization). Empty segments
     produce -inf; callers mask afterwards."""
-    return jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+    return jax.ops.segment_max(
+        data, segment_ids, num_segments=num_segments,
+        indices_are_sorted=indices_are_sorted,
+    )
 
 
 def segment_mean(
@@ -380,7 +384,8 @@ def segment_mean(
 
 
 def segment_softmax(
-    logits: jax.Array, segment_ids: jax.Array, num_segments: int, mask: jax.Array
+    logits: jax.Array, segment_ids: jax.Array, num_segments: int, mask: jax.Array,
+    indices_are_sorted: bool = False,  # plan-guaranteed for owner-side ids
 ) -> jax.Array:
     """Numerically-stable softmax over segments (per-dst-vertex attention).
 
@@ -395,9 +400,9 @@ def segment_softmax(
     Returns [E, H] normalized weights (masked edges -> 0).
     """
     logits = jnp.where(mask[..., None] > 0, logits, -jnp.inf)
-    seg_max = segment_max(logits, segment_ids, num_segments)
+    seg_max = segment_max(logits, segment_ids, num_segments, indices_are_sorted)
     seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
     shifted = jnp.where(mask[..., None] > 0, logits - seg_max[segment_ids], -jnp.inf)
     expd = jnp.where(mask[..., None] > 0, jnp.exp(shifted), 0.0)
-    denom = segment_sum(expd, segment_ids, num_segments)
+    denom = segment_sum(expd, segment_ids, num_segments, indices_are_sorted)
     return expd / jnp.maximum(denom[segment_ids], 1e-12)
