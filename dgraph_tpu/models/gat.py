@@ -65,7 +65,7 @@ class GATConv(nn.Module):
         # local softmax over incoming edges of each dst vertex
         alpha = local_ops.segment_softmax(
             logits, plan.dst_index, plan.n_dst_pad, plan.edge_mask,
-            indices_are_sorted=plan.owner_sorted,
+            indices_are_sorted=plan.ids_sorted("dst"),
         )  # [e_pad, H]
         msg = (alpha[..., None] * h_src).reshape(-1, H * D)
         out = self.comm.scatter_sum(msg, plan, side="dst").reshape(-1, H, D)

@@ -36,6 +36,14 @@ class RelationalAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x_src: jax.Array, x_dst: jax.Array, plan) -> jax.Array:
+        if plan.halo_side != "src":
+            raise ValueError(
+                "RelationalAttention requires dst-owned edges "
+                "(halo_side='src'): with src-owned plans the dst index uses "
+                "halo-slot numbering, so a rank-local softmax over "
+                "n_dst_pad segments would silently drop remote "
+                "contributions from the normalizer"
+            )
         from dgraph_tpu import config as _cfg
 
         dt = _cfg.resolve_compute_dtype(self.dtype)
@@ -55,9 +63,7 @@ class RelationalAttention(nn.Module):
         logits = nn.leaky_relu(logits, self.negative_slope)
         alpha = local_ops.segment_softmax(
             logits, plan.dst_index, plan.n_dst_pad, plan.edge_mask,
-            # dst ids are monotone only when dst is the OWNER side (a
-            # src-owned plan's dst_index is the halo-side numbering)
-            indices_are_sorted=plan.owner_sorted and plan.halo_side == "src",
+            indices_are_sorted=plan.ids_sorted("dst"),
         )
         msg = (alpha[..., None] * h_src).reshape(-1, H * D)
         out = self.comm.scatter_sum(msg, plan, side="dst")

@@ -362,6 +362,15 @@ class EdgePlan:
     halo_sorted_ids: Any = None  # i32[W, E] or None
     halo_sort_mc: int = 1  # static; max_chunks hint for the sorted route
 
+    def ids_sorted(self, side: str) -> bool:
+        """True iff this side's per-edge index is monotone: the OWNER side
+        of an owner-sorted plan. The halo side mixes local rows with halo
+        slots and is never monotone — asserting sortedness there makes
+        XLA's monotone-scatter path silently corrupt reductions, so every
+        ``indices_are_sorted`` hint must come from here, not from a
+        re-derived ``owner_sorted and ...`` expression at the call site."""
+        return self.owner_sorted and side != self.halo_side
+
 
 def plan_memory_usage(plan: EdgePlan, feature_dim: int, dtype_bytes: int = 4) -> dict:
     """Byte accounting of a plan and its runtime buffers — parity with
