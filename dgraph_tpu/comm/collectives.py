@@ -196,7 +196,7 @@ def gather(
         full = x
         # owner-side ids are plan-sorted; route the VJP (a scatter-sum
         # transpose, _torch_func_impl.py:112-191) through the sorted path
-        sorted_ids = plan.owner_sorted
+        sorted_ids = plan.ids_sorted(side)
     hints = (
         (plan.scatter_block_e, plan.scatter_block_n, plan.scatter_mc)
         if (sorted_ids and _cfg.pallas_scatter_enabled())
@@ -234,7 +234,7 @@ def scatter_sum(
         # owner-side aggregation: plan-sorted monotone segment ids ride the
         # shared Pallas-or-jnp dispatch (kill switch + precision policy in
         # ONE place: ops.local.sorted_segment_sum_any)
-        if plan.owner_sorted:
+        if plan.ids_sorted(side):
             return local_ops.sorted_segment_sum_any(
                 edata, idx, n_pad, plan.scatter_block_e, plan.scatter_block_n,
                 plan.scatter_mc,
@@ -283,7 +283,7 @@ def scatter_bias_relu(
     # one compute dtype on both paths: the kernel runs bias at edata's
     # precision, so the fallback must too (cross-backend equivalence)
     bias = bias.astype(edata.dtype)
-    if side != plan.halo_side and plan.owner_sorted:
+    if plan.ids_sorted(side):
         # owner side: shared Pallas-or-jnp dispatch (kill switch + precision
         # policy in ONE place — ops.local)
         return local_ops.sorted_segment_sum_bias_relu_any(

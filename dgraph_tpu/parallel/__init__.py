@@ -23,6 +23,7 @@ Tensor/pipeline/expert parallelism are absent in the reference (SURVEY §2.3)
 and in scope for later rounds here.
 """
 
+from dgraph_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from dgraph_tpu.parallel.sequence import (
     dense_attention,
     ring_attention,
@@ -48,6 +49,8 @@ from dgraph_tpu.comm.mesh import (
 )
 
 __all__ = [
+    "pipeline_apply",
+    "stack_stage_params",
     "dense_attention",
     "ring_attention",
     "ring_attention_sharded",

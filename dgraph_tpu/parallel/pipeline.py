@@ -1,0 +1,94 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a mesh axis.
+
+Beyond-reference (SURVEY.md §2.3 lists pipeline parallelism as absent in
+the reference). Stages live on consecutive ranks of a ``pipe`` mesh axis;
+activations hop stage-to-stage with ``lax.ppermute`` while microbatches
+stream through, so at steady state every stage computes a different
+microbatch concurrently — the classic bubble of (S-1) slots at the ramp
+ends, amortized by the microbatch count M (efficiency M / (M + S - 1)).
+
+TPU-first shape: the whole schedule is ONE ``lax.scan`` inside
+``shard_map`` — no host round trips, no per-step dispatch; XLA sees a
+static loop of compute + neighbor ``CollectivePermute`` and overlaps them.
+Differentiable end to end: the scan/ppermute transpose runs the reverse
+schedule (backward pipeline) automatically — no hand-written schedule.
+
+Scope: homogeneous stages (same params pytree structure per stage — e.g.
+N identical transformer blocks split across ranks). Heterogeneous
+first/last stages (embed/head) stay outside the pipelined region, which is
+how the classic GPipe deployments slice models anyway.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable,  # (stage_params, x_mb) -> y_mb, same shape
+    stage_params,  # THIS rank's stage parameters (pytree)
+    x_micro: jax.Array,  # [M, mb, ...] microbatches (valid on stage 0)
+    axis_name: str,
+) -> jax.Array:
+    """Run ``x_micro`` through S pipelined stages (S = axis size).
+
+    Stage s applies ``stage_fn(stage_params, ·)`` on rank s; the result of
+    the LAST stage is returned on every rank (broadcast via the final
+    collective) with shape [M, mb, ...].
+
+    Call inside ``shard_map``; shard ``stage_params`` over ``axis_name``
+    (one stage's params per rank) and replicate ``x_micro`` or feed it on
+    stage 0 (other ranks' copies are ignored).
+    """
+    S = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    M = x_micro.shape[0]
+    mb_shape = x_micro.shape[1:]
+    total = M + S - 1  # ramp-up + steady + ramp-down
+
+    vary = lambda t: lax.pcast(t, axis_name, to="varying")
+    state = vary(jnp.zeros(mb_shape, x_micro.dtype))  # current activation
+    out = vary(jnp.zeros((M,) + mb_shape, x_micro.dtype))
+
+    def step(carry, t):
+        state, out = carry
+        # stage 0 ingests microbatch t (zeros once the stream is done);
+        # other ranks use what arrived from the previous stage
+        mb_idx = jnp.clip(t, 0, M - 1)
+        injected = jnp.where(
+            (rank == 0) & (t < M),
+            lax.dynamic_index_in_dim(x_micro, mb_idx, keepdims=False),
+            state,
+        )
+        y = stage_fn(stage_params, injected)
+        # the LAST stage's output at step t is microbatch (t - (S-1));
+        # store it (every rank stores — only the last stage's rows are
+        # meaningful, selected by the psum-broadcast below)
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        is_valid = (rank == S - 1) & (t >= S - 1)
+        cur = lax.dynamic_index_in_dim(out, out_idx, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(is_valid, y, cur), out_idx, axis=0
+        )
+        # hop the activation to the next stage
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, out), None
+
+    (state, out), _ = lax.scan(step, (state, out), jnp.arange(total))
+    # broadcast the last stage's collected outputs to every rank (psum of
+    # one-hot contributions: only rank S-1 holds nonzero rows)
+    contrib = jnp.where(rank == S - 1, out, jnp.zeros_like(out))
+    return lax.psum(contrib, axis_name)
+
+
+def stack_stage_params(params_list):
+    """Host helper: stack S per-stage pytrees into one pytree with a
+    leading [S] axis, ready to shard with ``P('pipe')``."""
+    import numpy as np
+
+    return jax.tree.map(lambda *xs: np.stack(xs), *params_list)

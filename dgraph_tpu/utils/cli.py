@@ -84,7 +84,15 @@ def _coerce(raw: str, ann):
             return None
         ann = args[0]
     if isinstance(ann, str):
-        ann = {"int": int, "float": float, "str": str, "bool": bool}.get(ann, str)
+        # string annotations (from __future__ import annotations): unwrap
+        # "Optional[int]" -> "int" before the name lookup, else the field
+        # silently stays a str
+        m = ann.strip()
+        if m.startswith("Optional[") and m.endswith("]"):
+            if raw.lower() in ("none", "null"):
+                return None
+            m = m[len("Optional[") : -1]
+        ann = {"int": int, "float": float, "str": str, "bool": bool}.get(m, str)
     if ann is bool:
         return raw.strip().lower() in ("1", "true", "yes", "on")
     if ann in (int, float, str):

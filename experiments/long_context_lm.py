@@ -53,8 +53,11 @@ def main(cfg: Config):
 
     W = cfg.world_size or len(jax.devices())
     T = cfg.seq_len
-    if T % W:
-        raise SystemExit(f"seq_len {T} must divide by world_size {W}")
+    if T % W or T % 2:
+        raise SystemExit(
+            f"seq_len {T} must be even (induction corpus halves) and divide "
+            f"by world_size {W}"
+        )
     mesh = Mesh(np.array(jax.devices()[:W]), ("graph",))
     comm = Communicator.init_process_group("tpu", world_size=W)
     model = SeqTransformerLM(
@@ -110,7 +113,6 @@ def main(cfg: Config):
                     "ms_per_step": (time.perf_counter() - t0) / (i + 1) * 1e3,
                 }
                 log.write(rec)
-                print(rec)
 
 
 if __name__ == "__main__":
