@@ -16,14 +16,28 @@ Maps the reference's parallelism inventory (SURVEY.md §2.3) onto mesh axes:
   :class:`~dgraph_tpu.models.norm.DistributedBatchNorm`.
 
 - Sequence/context parallelism (absent in the reference; first-class here):
-  ring attention with K/V blocks streaming over ``lax.ppermute`` —
-  :mod:`dgraph_tpu.parallel.sequence`.
+  ring attention (K/V blocks streaming over ``lax.ppermute``) and the
+  Ulysses all-to-all layout swap — :mod:`dgraph_tpu.parallel.sequence`.
+- Pipeline parallelism: GPipe microbatch streaming over a ``pipe`` axis —
+  :mod:`dgraph_tpu.parallel.pipeline`.
+- Tensor parallelism: Megatron column/row-parallel linear pairs —
+  :mod:`dgraph_tpu.parallel.tensor`.
+- Expert parallelism: top-1 token-dispatch MoE over an ``expert`` axis —
+  :mod:`dgraph_tpu.parallel.expert`.
 
-Tensor/pipeline/expert parallelism are absent in the reference (SURVEY §2.3)
-and in scope for later rounds here.
+Every strategy in SURVEY §2.3 (plus four the reference lacks) is therefore
+implemented and tested on the virtual 8-device mesh.
 """
 
+from dgraph_tpu.parallel.expert import load_balance_loss, moe_apply, top1_dispatch
 from dgraph_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from dgraph_tpu.parallel.tensor import (
+    column_parallel_dense,
+    row_parallel_dense,
+    shard_columns,
+    shard_rows,
+    tensor_parallel_mlp,
+)
 from dgraph_tpu.parallel.sequence import (
     dense_attention,
     ring_attention,
@@ -49,6 +63,14 @@ from dgraph_tpu.comm.mesh import (
 )
 
 __all__ = [
+    "column_parallel_dense",
+    "row_parallel_dense",
+    "tensor_parallel_mlp",
+    "shard_columns",
+    "shard_rows",
+    "moe_apply",
+    "top1_dispatch",
+    "load_balance_loss",
     "pipeline_apply",
     "stack_stage_params",
     "dense_attention",

@@ -1,0 +1,111 @@
+"""Expert parallelism: top-1 token-dispatch mixture-of-experts over a mesh
+axis.
+
+Beyond-reference (SURVEY.md §2.3 lists expert parallelism as absent in the
+reference). One expert lives on each rank of an ``expert`` axis; a learned
+router picks an expert per token; tokens travel to their expert and back
+with the SAME padded ``all_to_all`` discipline as the halo exchange
+(static per-peer capacity, masked overflow) — XLA's compile-once model
+wants fixed shapes, so the classic "capacity factor" of production MoE
+layers is the exact analogue of this framework's ``s_pad`` halo padding.
+
+Dispatch math is all segment/one-hot primitives already used by the graph
+side: position-within-expert via a cumulative sum over the one-hot routing
+matrix, inverse routing by scatter into the dispatch slots' origin rows.
+Differentiable end to end (routing probabilities scale the expert outputs
+— the standard top-1 switch estimator; the all_to_all transposes are
+all_to_alls).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_dispatch(
+    x: jax.Array,  # [T, F] this shard's tokens
+    router_logits: jax.Array,  # [T, E] router scores (E = axis size)
+    capacity: int,  # per-(src shard -> expert) slot budget (static)
+    axis_name: str,
+):
+    """Route each token to its argmax expert; returns everything the
+    combine step needs.
+
+    Returns (expert_in, combine): ``expert_in`` [W*capacity, F] — the
+    tokens THIS rank's expert must process (from every peer, peer p's
+    block at rows [p*capacity, (p+1)*capacity)); ``combine(expert_out)``
+    scatters processed rows back to their origin tokens, scaled by the
+    router probability (zeros for dropped/overflow tokens).
+    """
+    T, F = x.shape
+    E = lax.psum(1, axis_name)
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]  # [T]
+
+    # position of each token within its expert's send block (one-hot cumsum
+    # — same trick as the plan builder's slot numbering, done in-jit)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [T, E]
+    pos = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(T), expert]  # [T]
+    keep = pos < capacity  # overflow tokens are dropped (capacity factor)
+
+    # build the per-expert send buffer [E, capacity, F]
+    slot = jnp.where(keep, expert * capacity + pos, E * capacity)
+    send = jnp.zeros((E * capacity, F), x.dtype).at[slot].set(
+        x, mode="drop"
+    ).reshape(E, capacity, F)
+    # tokens land on their expert's rank, peer blocks in rank order — the
+    # halo-exchange landing discipline
+    expert_in = lax.all_to_all(
+        send, axis_name, split_axis=0, concat_axis=0
+    ).reshape(E * capacity, F)
+
+    def combine(expert_out: jax.Array) -> jax.Array:  # [W*capacity, F']
+        back = lax.all_to_all(
+            expert_out.reshape(E, capacity, -1), axis_name,
+            split_axis=0, concat_axis=0,
+        ).reshape(E * capacity, -1)
+        rows = jnp.take(back, jnp.minimum(slot, E * capacity - 1), axis=0)
+        rows = jnp.where(keep[:, None], rows, 0.0)
+        # scale by the router prob: the top-1 switch gradient estimator —
+        # the router learns through this product
+        return rows * gate[:, None].astype(rows.dtype)
+
+    return expert_in, combine
+
+
+def moe_apply(
+    x: jax.Array,  # [T, F] this shard's tokens
+    router_logits: jax.Array,  # [T, E]
+    expert_fn: Callable,  # (params, [N, F]) -> [N, F'] THIS rank's expert
+    expert_params,
+    capacity: int,
+    axis_name: str,
+) -> jax.Array:
+    """Full top-1 MoE layer: dispatch -> local expert -> combine.
+
+    ONE ``all_to_all`` each way — two per layer, the textbook MoE cost;
+    overflow beyond ``capacity`` per (shard, expert) pair contributes zeros (route
+    a residual around the layer upstream, as switch transformers do).
+    """
+    expert_in, combine = top1_dispatch(x, router_logits, capacity, axis_name)
+    return combine(expert_fn(expert_params, expert_in))
+
+
+def load_balance_loss(router_logits: jax.Array, axis_name: str) -> jax.Array:
+    """Switch-transformer auxiliary loss: E * Σ_e (frac_tokens_e ·
+    mean_prob_e), psum-averaged over the axis. Add to the task loss to keep
+    routing spread across experts."""
+    E = lax.psum(1, axis_name)
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    frac = jnp.mean(
+        jax.nn.one_hot(jnp.argmax(probs, -1), E, dtype=jnp.float32), axis=0
+    )
+    mean_p = probs.mean(axis=0)
+    frac = lax.pmean(frac, axis_name)
+    mean_p = lax.pmean(mean_p, axis_name)
+    return E * jnp.sum(frac * mean_p)

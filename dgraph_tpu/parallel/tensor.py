@@ -1,0 +1,74 @@
+"""Tensor (operator) parallelism: Megatron-style column/row-parallel
+linear pairs over a mesh axis.
+
+Beyond-reference (SURVEY.md §2.3 lists tensor parallelism as absent in the
+reference). The classic pairing for an MLP/attention block:
+
+- **column-parallel** first linear: weight [F, H/W] per rank, no
+  communication on the forward (input is replicated over the axis);
+- elementwise nonlinearity on the [.., H/W] shard;
+- **row-parallel** second linear: weight [H/W, F] per rank, one ``psum``
+  on the forward to reduce the partial products.
+
+Exactly one collective per pair in each direction — AD transposes the
+forward ``psum`` into the backward identity and vice versa, so the
+backward also has one collective (the input-gradient reduction of the
+column layer). Composes freely with the other axes of a mesh
+(graph/replica/pipe): these helpers only touch ``axis_name``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def column_parallel_dense(x, w_shard, b_shard=None):
+    """y_shard = x @ w_shard (+ b_shard): input replicated over the tensor
+    axis, output feature-sharded. No communication."""
+    y = x @ w_shard
+    if b_shard is not None:
+        y = y + b_shard
+    return y
+
+
+def row_parallel_dense(x_shard, w_shard, axis_name: str, b=None):
+    """y = psum_over_axis(x_shard @ w_shard) (+ b): input feature-sharded,
+    output replicated. ONE psum; add the (replicated) bias AFTER the
+    reduction so it isn't summed W times."""
+    y = lax.psum(x_shard @ w_shard, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def tensor_parallel_mlp(
+    x: jax.Array,  # [.., F] replicated over the tensor axis
+    w1_shard: jax.Array,  # [F, H/W] this rank's column shard
+    b1_shard: Optional[jax.Array],  # [H/W] or None
+    w2_shard: jax.Array,  # [H/W, F] this rank's row shard
+    b2: Optional[jax.Array],  # [F] replicated or None
+    axis_name: str,
+    activation: Callable = jax.nn.silu,
+) -> jax.Array:
+    """The canonical column->act->row pair: one forward psum total."""
+    h = activation(column_parallel_dense(x, w1_shard, b1_shard))
+    return row_parallel_dense(h, w2_shard, axis_name, b2)
+
+
+def shard_columns(w, num_shards: int, rank_axis: int = -1):
+    """Host helper: split a dense weight into per-rank column shards with a
+    leading [W] axis (shard with ``P('tensor')``)."""
+    import numpy as np
+
+    return np.stack(np.split(np.asarray(w), num_shards, axis=rank_axis))
+
+
+def shard_rows(w, num_shards: int):
+    """Host helper: per-rank row shards, leading [W] axis."""
+    import numpy as np
+
+    return np.stack(np.split(np.asarray(w), num_shards, axis=0))

@@ -71,14 +71,19 @@ def parse_config(config_cls, argv=None):
         leaf = parts[-1]
         if not hasattr(obj, leaf):
             raise SystemExit(f"unknown config field: {key}")
-        ann = {f.name: f.type for f in dataclasses.fields(obj)}[leaf]
+        # get_type_hints resolves STRING annotations (`from __future__
+        # import annotations` stringifies every ann — 'Optional[int]',
+        # 'int | None', ... would all coerce to str via f.type)
+        ann = typing.get_type_hints(type(obj))[leaf]
         setattr(obj, leaf, _coerce(raw, ann))
     return cfg
 
 
 def _coerce(raw: str, ann):
+    import types
+
     origin = typing.get_origin(ann)
-    if origin is typing.Union:  # Optional[...]
+    if origin in (typing.Union, types.UnionType):  # Optional[X] / X | None
         args = [a for a in typing.get_args(ann) if a is not type(None)]
         if raw.lower() in ("none", "null"):
             return None
