@@ -43,6 +43,11 @@ def top1_dispatch(
     """
     T, F = x.shape
     E = lax.psum(1, axis_name)
+    if router_logits.shape[-1] != E:  # both static under shard_map
+        raise ValueError(
+            f"router width {router_logits.shape[-1]} != expert-axis size "
+            f"{E}: out-of-range expert ids would be silently dropped"
+        )
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
     expert = jnp.argmax(probs, axis=-1)  # [T]
     gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]  # [T]

@@ -69,13 +69,15 @@ def parse_config(config_cls, argv=None):
         for p in parts[:-1]:
             obj = getattr(obj, p)
         leaf = parts[-1]
-        if not hasattr(obj, leaf):
-            raise SystemExit(f"unknown config field: {key}")
         # get_type_hints resolves STRING annotations (`from __future__
         # import annotations` stringifies every ann — 'Optional[int]',
-        # 'int | None', ... would all coerce to str via f.type)
-        ann = typing.get_type_hints(type(obj))[leaf]
-        setattr(obj, leaf, _coerce(raw, ann))
+        # 'int | None', ... would all coerce to str via f.type); it is also
+        # the membership check (hasattr would admit properties/methods and
+        # then KeyError below)
+        hints = typing.get_type_hints(type(obj))
+        if leaf not in hints:
+            raise SystemExit(f"unknown config field: {key}")
+        setattr(obj, leaf, _coerce(raw, hints[leaf]))
     return cfg
 
 
@@ -88,16 +90,6 @@ def _coerce(raw: str, ann):
         if raw.lower() in ("none", "null"):
             return None
         ann = args[0]
-    if isinstance(ann, str):
-        # string annotations (from __future__ import annotations): unwrap
-        # "Optional[int]" -> "int" before the name lookup, else the field
-        # silently stays a str
-        m = ann.strip()
-        if m.startswith("Optional[") and m.endswith("]"):
-            if raw.lower() in ("none", "null"):
-                return None
-            m = m[len("Optional[") : -1]
-        ann = {"int": int, "float": float, "str": str, "bool": bool}.get(m, str)
     if ann is bool:
         return raw.strip().lower() in ("1", "true", "yes", "on")
     if ann in (int, float, str):
