@@ -408,6 +408,12 @@ def bench_graphcast(dtype_name: str):
     return ms, {"level": level, "latent": latent, "layers": layers}
 
 
+# Partial results land here as soon as each stage measures, so the
+# watchdog can emit the best-known JSON instead of null when a LATER stage
+# (e.g. the GraphCast level-6 compile) blows the budget.
+_PARTIAL: dict = {}
+
+
 def _arm_watchdog():
     """A wedged tunnel lease hangs ANY device op indefinitely (observed
     r1+r2); fail loudly with a JSON line instead of hanging the driver."""
@@ -416,13 +422,16 @@ def _arm_watchdog():
     budget = int(os.environ.get("DGRAPH_BENCH_TIMEOUT", "2400"))
 
     def _bail(signum, frame):
-        print(json.dumps({
+        out = {
             "metric": "arxiv_gcn_epoch_time", "value": None, "unit": "ms",
             "vs_baseline": None,
-            "error": f"watchdog: no result within {budget}s (wedged TPU lease?)",
-        }))
+            "error": f"watchdog: incomplete within {budget}s (wedged TPU lease?)",
+        }
+        out.update(_PARTIAL)  # keep any stage that DID finish
+        print(json.dumps(out))
         sys.stdout.flush()
-        os._exit(3)
+        # the GCN metric alone is a valid (partial) result
+        os._exit(0 if _PARTIAL.get("value") else 3)
 
     signal.signal(signal.SIGALRM, _bail)
     signal.alarm(budget)
@@ -459,6 +468,20 @@ def main():
 
     dt_ms, roof = bench_gcn(dtype_name)
     log(f"gcn epoch time {dt_ms:.2f} ms {roof}")
+    vs = None  # null when there is no measurement (don't imply parity)
+    if dt_ms == dt_ms:
+        base_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json"
+        )
+        try:
+            base = json.load(open(base_path))
+            if base.get("unit") == "ms" and base.get("value"):
+                vs = round(float(base["value"]) / dt_ms, 4)  # >1 = faster
+        except Exception:
+            pass
+        # record for the watchdog's partial-result JSON (the GraphCast
+        # compile below can blow the budget; the GCN metric must survive)
+        _PARTIAL.update({"value": round(dt_ms, 3), "vs_baseline": vs, **roof})
 
     gc_ms, gc_info = float("nan"), {}
     if os.environ.get("DGRAPH_BENCH_GRAPHCAST", "1") != "0":
@@ -467,18 +490,6 @@ def main():
             log(f"graphcast step time {gc_ms:.2f} ms {gc_info}")
         except Exception as e:  # stage-2 failure must not kill the metric
             log(f"graphcast stage failed: {type(e).__name__}: {e}")
-
-    vs = None  # null when there is no measurement (don't imply parity)
-    base_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json"
-    )
-    if os.path.exists(base_path) and dt_ms == dt_ms:
-        try:
-            base = json.load(open(base_path))
-            if base.get("unit") == "ms" and base.get("value"):
-                vs = round(float(base["value"]) / dt_ms, 4)  # >1 = faster
-        except Exception:
-            pass
 
     out = {
         "metric": "arxiv_gcn_epoch_time",

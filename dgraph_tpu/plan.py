@@ -477,6 +477,22 @@ def validate_plan(plan: EdgePlan) -> None:
     counts = np_.asarray(plan.num_edges)
     if (counts > plan.e_pad).any():
         errors.append("num_edges exceeds e_pad")
+    if plan.halo_sort_perm is not None:
+        # sorted route: perm must be a permutation of [0, e_pad) per shard
+        # and the recorded sorted ids must equal halo_idx[perm], monotone
+        perm = np_.asarray(plan.halo_sort_perm)
+        sids = np_.asarray(plan.halo_sorted_ids)
+        halo_idx = src if plan.halo_side == "src" else dst
+        for r in range(W):
+            if not np_.array_equal(np_.sort(perm[r]), np_.arange(plan.e_pad)):
+                errors.append(f"halo_sort_perm[{r}] is not a permutation")
+                break
+            if (np_.diff(sids[r]) < 0).any():
+                errors.append(f"halo_sorted_ids[{r}] not monotone")
+                break
+            if not np_.array_equal(halo_idx[r][perm[r]], sids[r]):
+                errors.append(f"halo_sorted_ids[{r}] != halo_index[perm]")
+                break
     if errors:
         raise ValueError("invalid EdgePlan: " + "; ".join(errors))
 

@@ -58,3 +58,52 @@ def test_scale_plan_build_5m_edges(rng):
     validate_plan(plan)
     assert float(np.asarray(plan.edge_mask).sum()) == edges.shape[1]
     assert dt < 120, f"plan build too slow: {dt:.1f}s"
+
+
+class TestSortRouteValidation:
+    """validate_plan's halo-sort-route checks: a valid plan passes; each
+    corruption class (non-permutation, non-monotone, ids mismatch) is
+    rejected — stale/corrupt cached plans must rebuild, not silently feed
+    the Pallas sorted kernels."""
+
+    def _plan(self):
+        rng = np.random.default_rng(3)
+        V, E, W = 64, 400, 4
+        edges = np.stack([rng.integers(0, V, E), rng.integers(0, V, E)])
+        part = np.sort(rng.integers(0, W, V)).astype(np.int32)
+        return pl.build_edge_plan(edges, part, world_size=W, edge_owner="dst")[0]
+
+    def test_valid_plan_passes(self):
+        validate_plan(self._plan())
+
+    def test_non_monotone_sorted_ids_rejected(self):
+        import dataclasses
+
+        plan = self._plan()
+        bad = dataclasses.replace(
+            plan,
+            halo_sorted_ids=np.flip(np.asarray(plan.halo_sorted_ids), axis=1),
+        )
+        with pytest.raises(ValueError, match="not monotone"):
+            validate_plan(bad)
+
+    def test_non_permutation_rejected(self):
+        import dataclasses
+
+        plan = self._plan()
+        perm = np.asarray(plan.halo_sort_perm).copy()
+        perm[0, 0] = perm[0, 1]  # duplicate entry: not a permutation
+        bad = dataclasses.replace(plan, halo_sort_perm=perm)
+        with pytest.raises(ValueError, match="not a permutation"):
+            validate_plan(bad)
+
+    def test_ids_mismatch_rejected(self):
+        import dataclasses
+
+        plan = self._plan()
+        sids = np.asarray(plan.halo_sorted_ids).copy()
+        # keep monotone but break the halo_index[perm] == sorted_ids tie
+        sids[0] = np.clip(sids[0] + 1, 0, None)
+        bad = dataclasses.replace(plan, halo_sorted_ids=sids)
+        with pytest.raises(ValueError, match="!= halo_index"):
+            validate_plan(bad)
