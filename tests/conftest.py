@@ -42,3 +42,15 @@ def mesh8():
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def tensor_mesh8():
+    """8-device 1-D mesh named 'tensor' (tensor-parallel tests)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("need 8 devices")
+    return Mesh(np.array(devs[:8]), ("tensor",))

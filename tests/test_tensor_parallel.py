@@ -18,13 +18,6 @@ W = 8
 B, F, H = 4, 16, 64  # batch, features, hidden (H % W == 0)
 
 
-def _mesh():
-    devs = jax.devices()
-    if len(devs) < W:
-        pytest.skip(f"need {W} devices")
-    return Mesh(np.array(devs[:W]), ("tensor",))
-
-
 def _weights(rng):
     w1 = rng.standard_normal((F, H)).astype(np.float32) * 0.3
     b1 = rng.standard_normal(H).astype(np.float32) * 0.1
@@ -59,8 +52,8 @@ def _shards(w1, b1, w2):
     )
 
 
-def test_tp_mlp_equals_dense():
-    mesh = _mesh()
+def test_tp_mlp_equals_dense(tensor_mesh8):
+    mesh = tensor_mesh8
     rng = np.random.default_rng(0)
     w1, b1, w2, b2 = _weights(rng)
     x = jnp.asarray(rng.standard_normal((B, F)), jnp.float32)
@@ -72,8 +65,8 @@ def test_tp_mlp_equals_dense():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_tp_mlp_gradients_equal_dense():
-    mesh = _mesh()
+def test_tp_mlp_gradients_equal_dense(tensor_mesh8):
+    mesh = tensor_mesh8
     rng = np.random.default_rng(1)
     w1, b1, w2, b2 = _weights(rng)
     x = jnp.asarray(rng.standard_normal((B, F)), jnp.float32)
@@ -106,9 +99,9 @@ def test_tp_mlp_gradients_equal_dense():
                                    rtol=5e-5, atol=5e-5)
 
 
-def test_single_forward_collective():
+def test_single_forward_collective(tensor_mesh8):
     """Structural pin: exactly one psum in the forward shard_map body."""
-    mesh = _mesh()
+    mesh = tensor_mesh8
     rng = np.random.default_rng(2)
     w1, b1, w2, b2 = _weights(rng)
     x = jnp.asarray(rng.standard_normal((B, F)), jnp.float32)

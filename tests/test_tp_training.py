@@ -1,0 +1,106 @@
+"""Sharded-PARAMETER training: tensor-parallel weights stay sharded through
+forward, backward, and the optimizer update — no device ever holds the full
+weight. The memory story tensor parallelism exists for, executed end to end
+(replicated-params loops like train/loop.py cover the other regime)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dgraph_tpu.parallel.tensor import (
+    shard_columns,
+    shard_rows,
+    tensor_parallel_mlp,
+)
+
+W = 8
+B, F, H = 8, 16, 64
+
+
+def test_sharded_param_training_matches_dense(tensor_mesh8):
+    """N steps of adam on sharded params == N steps on the dense params."""
+    mesh = tensor_mesh8
+    rng = np.random.default_rng(0)
+    w1 = rng.standard_normal((F, H)).astype(np.float32) * 0.3
+    w2 = rng.standard_normal((H, F)).astype(np.float32) * 0.3
+    x = jnp.asarray(rng.standard_normal((B, F)), jnp.float32)
+    tgt = jnp.asarray(rng.standard_normal((B, F)), jnp.float32)
+
+    # ---- sharded run: params enter shard_map with P('tensor') specs and
+    # are updated PER SHARD (grads of sharded params need no collective —
+    # each shard's weight slice only ever touches its own activations) ----
+    params = {
+        "w1": jnp.asarray(shard_columns(w1, W)),  # [W, F, H/W]
+        "w2": jnp.asarray(shard_rows(w2, W)),  # [W, H/W, F]
+    }
+    opt = optax.adam(1e-2)
+
+    def shard_step(p, o, x, tgt):
+        # per-shard loss/grad: the ONLY collective is the row-parallel psum
+        # in the forward (+ its transpose); param grads stay sharded
+        def lf(p):
+            y = tensor_parallel_mlp(
+                x, p["w1"][0], None, p["w2"][0], None, "tensor"
+            )
+            return ((y - tgt) ** 2).sum()
+
+        loss, g = jax.value_and_grad(lf)(p)
+        updates, o = opt.update(g, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    # init the opt state on the HOST over the stacked [W, ...] params: its
+    # moment leaves inherit the sharded shapes, scalars (adam's count) stay
+    # replicated — per-leaf specs express exactly that
+    o0 = opt.init(params)
+    o_specs = jax.tree.map(
+        lambda l: P("tensor") if getattr(l, "ndim", 0) > 0 else P(), o0
+    )
+    step = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(P("tensor"), o_specs, P(), P()),
+        out_specs=(P("tensor"), o_specs, P()),
+        check_vma=False,
+    )
+
+    with jax.set_mesh(mesh):
+        o = o0
+        losses = []
+        for _ in range(5):
+            params, o, l = step(params, o, x, tgt)
+            losses.append(float(l))
+
+    # ---- dense oracle ----
+    dp = {"w1": jnp.asarray(w1), "w2": jnp.asarray(w2)}
+    dopt_state = opt.init(dp)
+
+    @jax.jit
+    def dense_step(p, o):
+        def lf(p):
+            y = jax.nn.silu(x @ p["w1"]) @ p["w2"]
+            return ((y - tgt) ** 2).sum()
+
+        loss, g = jax.value_and_grad(lf)(p)
+        updates, o = opt.update(g, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    dlosses = []
+    for _ in range(5):
+        dp, dopt_state, dl = dense_step(dp, dopt_state)
+        dlosses.append(float(dl))
+
+    np.testing.assert_allclose(losses, dlosses, rtol=2e-4)
+    # final sharded weights == re-sharded dense weights
+    np.testing.assert_allclose(
+        np.asarray(params["w1"]), shard_columns(np.asarray(dp["w1"]), W),
+        rtol=2e-4, atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(params["w2"]), shard_rows(np.asarray(dp["w2"]), W),
+        rtol=2e-4, atol=2e-5,
+    )
+    assert losses[-1] < losses[0]
