@@ -30,6 +30,10 @@ class Config:
     save_freq: int = 100
     microbenchmark: bool = False
     log_path: str = "logs/graphcast.jsonl"
+    # elastic knobs (train/elastic.py): SIGTERM/SIGINT triggers a final
+    # checkpoint + clean exit; a >0 deadline arms the per-step wedge
+    # watchdog (exit 17 = restart+resume me)
+    step_deadline_s: float = 0.0
 
 
 def main(cfg: Config):
@@ -145,29 +149,54 @@ def main(cfg: Config):
         _microbenchmark(model, params, statics, plans, mesh, comm, ds, log)
         return
 
-    with jax.set_mesh(mesh):
-        while step_idx < cfg.steps:
-            x, y = ds.get_sharded(step_idx)
-            t0 = time.perf_counter()
-            params, opt_state, loss = step(params, opt_state, jnp.asarray(x), jnp.asarray(y))
-            jax.block_until_ready(loss)
-            dt = (time.perf_counter() - t0) * 1000
-            step_idx += 1
-            if step_idx % 10 == 0 or step_idx == cfg.steps:
-                log.write(
-                    {
-                        "step": step_idx,
-                        "loss": float(loss),
-                        "step_ms": round(dt, 2),
-                        "lr": float(schedule(step_idx)),
-                    }
-                )
-            if cfg.ckpt_dir and step_idx % cfg.save_freq == 0:
-                save_checkpoint(
-                    cfg.ckpt_dir,
-                    {"params": params, "opt_state": opt_state, "step": step_idx},
-                    step_idx,
-                )
+    import contextlib
+
+    from dgraph_tpu.train.elastic import PreemptionGuard, StepWatchdog
+
+    # hand-rolled rather than run_elastic(): this loop owns per-step data
+    # feeding (ds.get_sharded) and custom logging; the elastic pieces used
+    # are the same objects, incl. watchdog suspension around saves
+    guard = PreemptionGuard()
+    dog = StepWatchdog(cfg.step_deadline_s) if cfg.step_deadline_s > 0 else None
+    try:
+        with jax.set_mesh(mesh):
+            while step_idx < cfg.steps:
+                x, y = ds.get_sharded(step_idx)
+                t0 = time.perf_counter()
+                params, opt_state, loss = step(params, opt_state, jnp.asarray(x), jnp.asarray(y))
+                jax.block_until_ready(loss)
+                if dog is not None:
+                    dog.beat()
+                dt = (time.perf_counter() - t0) * 1000
+                step_idx += 1
+                preempted = guard.should_stop()
+                if step_idx % 10 == 0 or step_idx == cfg.steps or preempted:
+                    log.write(
+                        {
+                            "step": step_idx,
+                            "loss": float(loss),
+                            "step_ms": round(dt, 2),
+                            "lr": float(schedule(step_idx)),
+                        }
+                    )
+                if cfg.ckpt_dir and (step_idx % cfg.save_freq == 0 or preempted):
+                    # a long orbax write is not a wedged device — suspend
+                    # the watchdog for the duration (elastic.py:_save)
+                    with (dog.suspended() if dog is not None
+                          else contextlib.nullcontext()):
+                        save_checkpoint(
+                            cfg.ckpt_dir,
+                            {"params": params, "opt_state": opt_state,
+                             "step": step_idx},
+                            step_idx,
+                        )
+                if preempted:
+                    log.write({"preempted_at_step": step_idx})
+                    break
+    finally:
+        if dog is not None:
+            dog.stop()
+        guard.uninstall()
     log.write({"timing": __import__("dgraph_tpu.utils", fromlist=["TimingReport"]).TimingReport.report()})
 
 
