@@ -519,8 +519,13 @@ class EdgePlanLayout:
 # 29.0 ms vs 512's 34.1 ms for [2.33M, 256] f32 sorted segment-sum
 # (logs/kernels_r2.jsonl). New plans carry these; old pickled plans keep the
 # blocks they were built with (EdgePlan field defaults + PLAN_FORMAT_VERSION).
-SCATTER_BLOCK_E = 1024
-SCATTER_BLOCK_N = 256
+# Env-overridable so an on-chip tile sweep (kernel_benchmarks --sweep) can
+# be applied to a fresh plan build without a code edit.
+import os as _os
+
+SCATTER_BLOCK_E = int(_os.environ.get("DGRAPH_TPU_SCATTER_BLOCK_E", "1024"))
+SCATTER_BLOCK_N = int(_os.environ.get("DGRAPH_TPU_SCATTER_BLOCK_N", "256"))
+del _os
 
 # Edge count above which build_edge_plan dispatches to the native streaming
 # core by default (the numpy path's lexsort/unique int64 temporaries are
