@@ -1,0 +1,28 @@
+"""Exponential moving average of parameters (Polyak averaging).
+
+The GraphCast training recipe evaluates with EMA weights; the reference
+repo omits this (its GraphCast trainer keeps only raw params). One pytree
+map per step, jit-safe, device-resident.
+
+Usage::
+
+    ema = ema_init(params)
+    for ...:
+        params, ... = train_step(...)
+        ema = ema_update(ema, params, decay=0.999)
+    eval_logits = model.apply(ema, ...)
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def ema_init(params):
+    """EMA state = a copy of the initial parameters."""
+    return jax.tree.map(lambda p: p, params)
+
+
+def ema_update(ema, params, decay: float = 0.999):
+    """ema <- decay * ema + (1 - decay) * params (elementwise, any pytree)."""
+    return jax.tree.map(lambda e, p: decay * e + (1.0 - decay) * p, ema, params)

@@ -179,3 +179,19 @@ def test_row_take_column_split(rng):
     gs = jax.grad(loss_split)(jnp.asarray(x))
     gp = jax.grad(loss_plain)(jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(gs), np.asarray(gp), rtol=1e-5, atol=1e-5)
+
+
+def test_ema_update():
+    """EMA converges toward the tracked params at rate (1-decay)."""
+    import jax.numpy as jnp
+
+    from dgraph_tpu.train.ema import ema_init, ema_update
+
+    p0 = {"w": jnp.zeros(4), "b": jnp.zeros(2)}
+    tgt = {"w": jnp.ones(4), "b": jnp.ones(2)}
+    ema = ema_init(p0)
+    for _ in range(10):
+        ema = ema_update(ema, tgt, decay=0.9)
+    expect = 1.0 - 0.9 ** 10
+    np.testing.assert_allclose(np.asarray(ema["w"]), expect, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ema["b"]), expect, rtol=1e-6)
