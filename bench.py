@@ -657,7 +657,6 @@ def main() -> int:
     emits the best-known JSON itself. SIGTERM/SIGINT (e.g. an outer
     `timeout` wrapper) likewise produce the JSON before dying."""
     import signal
-    import subprocess
     import tempfile
 
     budget = int(os.environ.get("DGRAPH_BENCH_TIMEOUT", "2400"))
@@ -695,81 +694,92 @@ def main() -> int:
     signal.signal(signal.SIGINT, _on_term)
 
     try:
-        # Phase 1: cheap init probes in throwaway subprocesses (each one a
-        # fresh process — no poisoned backend cache). The lease recovers on
-        # its own, so probe until half the budget is gone, then give up.
-        want = _expected_platform()
-        check = (f"assert jax.default_backend() == '{want}', "
-                 f"jax.default_backend()" if want else "pass")
-        # the probe must run a real device op + scalar fetch, not just
-        # init: a wedged lease can init PJRT fine and hang the first
-        # dispatch (the established wedge probe from r1+r2)
-        probe = [sys.executable, "-c",
-                 "import jax, jax.numpy as jnp; jax.devices(); "
-                 f"{check}; float(jnp.ones((8, 128)).sum())"]
-        phase1_end = deadline - 0.5 * budget
-        attempt = 0
-        while True:
-            attempt += 1
-            try:
-                pp = subprocess.Popen(probe, stdout=subprocess.DEVNULL,
-                                      stderr=subprocess.PIPE, text=True)
-                child_proc[0] = pp
-                _, perr = pp.communicate(
-                    timeout=min(150, max(5, phase1_end - time.time())))
-                if pp.returncode == 0:
-                    log(f"backend probe OK (attempt {attempt})")
-                    break
-                tail = (perr or "").strip().splitlines()
-                log(f"backend probe attempt {attempt} rc={pp.returncode}: "
-                    f"{tail[-1] if tail else '?'}")
-            except subprocess.TimeoutExpired:
-                pp.kill()
-                pp.communicate()
-                log(f"backend probe attempt {attempt} hung (wedged lease)")
-            finally:
-                child_proc[0] = None
-            if time.time() >= phase1_end:
-                return _supervisor_emit(
-                    {}, f"backend never initialized within {attempt} probes "
-                        f"(~{budget // 2}s); wedged TPU lease")
-            time.sleep(min(45, max(5, phase1_end - time.time())))
-
-        # Phase 2: the real bench, with the remaining budget minus a margin
-        # so the child's own watchdog fires first (richer JSON than ours).
-        # stderr is inherited: progress must stream live (a silent 30-min
-        # compile is indistinguishable from a wedge otherwise).
-        env = dict(os.environ)
-        env["DGRAPH_BENCH_CHILD"] = "1"
-        env["DGRAPH_BENCH_STATE"] = state_path
-        child_budget = max(60, int(deadline - time.time()) - 30)
-        env["DGRAPH_BENCH_TIMEOUT"] = str(child_budget)
-        p = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, stdout=subprocess.PIPE, text=True,
-        )
-        child_proc[0] = p
-        try:
-            stdout, _ = p.communicate(timeout=child_budget + 60)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            p.communicate()
-            return _supervisor_emit(
-                read_state(),
-                "bench child hung past its own watchdog; killed")
-        # pass through the child's JSON line + rc when it produced one
-        last = (stdout or "").strip().splitlines()
-        if last:
-            print(last[-1])
-            sys.stdout.flush()
-            return p.returncode
+        return _main_guarded(budget, deadline, read_state, child_proc,
+                             state_path)
+    except Exception as e:  # the LAST unstructured exit path: even an
+        # unexpected supervisor bug must not cost the round's JSON
         return _supervisor_emit(
-            read_state(), f"bench child died rc={p.returncode} with no JSON")
+            read_state(), f"supervisor crashed: {type(e).__name__}: {e}")
     finally:
         try:
             os.unlink(state_path)
         except OSError:
             pass
+
+
+def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
+    import subprocess
+
+    # Phase 1: cheap init probes in throwaway subprocesses (each one a
+    # fresh process — no poisoned backend cache). The lease recovers on
+    # its own, so probe until half the budget is gone, then give up.
+    want = _expected_platform()
+    check = (f"assert jax.default_backend() == '{want}', "
+             f"jax.default_backend()" if want else "pass")
+    # the probe must run a real device op + scalar fetch, not just
+    # init: a wedged lease can init PJRT fine and hang the first
+    # dispatch (the established wedge probe from r1+r2)
+    probe = [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; jax.devices(); "
+             f"{check}; float(jnp.ones((8, 128)).sum())"]
+    phase1_end = deadline - 0.5 * budget
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            pp = subprocess.Popen(probe, stdout=subprocess.DEVNULL,
+                                  stderr=subprocess.PIPE, text=True)
+            child_proc[0] = pp
+            _, perr = pp.communicate(
+                timeout=min(150, max(5, phase1_end - time.time())))
+            if pp.returncode == 0:
+                log(f"backend probe OK (attempt {attempt})")
+                break
+            tail = (perr or "").strip().splitlines()
+            log(f"backend probe attempt {attempt} rc={pp.returncode}: "
+                f"{tail[-1] if tail else '?'}")
+        except subprocess.TimeoutExpired:
+            pp.kill()
+            pp.communicate()
+            log(f"backend probe attempt {attempt} hung (wedged lease)")
+        finally:
+            child_proc[0] = None
+        if time.time() >= phase1_end:
+            return _supervisor_emit(
+                {}, f"backend never initialized within {attempt} probes "
+                    f"(~{budget // 2}s); wedged TPU lease")
+        time.sleep(min(45, max(5, phase1_end - time.time())))
+
+    # Phase 2: the real bench, with the remaining budget minus a margin
+    # so the child's own watchdog fires first (richer JSON than ours).
+    # stderr is inherited: progress must stream live (a silent 30-min
+    # compile is indistinguishable from a wedge otherwise).
+    env = dict(os.environ)
+    env["DGRAPH_BENCH_CHILD"] = "1"
+    env["DGRAPH_BENCH_STATE"] = state_path
+    child_budget = max(60, int(deadline - time.time()) - 30)
+    env["DGRAPH_BENCH_TIMEOUT"] = str(child_budget)
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, text=True,
+    )
+    child_proc[0] = p
+    try:
+        stdout, _ = p.communicate(timeout=child_budget + 60)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        p.communicate()
+        return _supervisor_emit(
+            read_state(),
+            "bench child hung past its own watchdog; killed")
+    # pass through the child's JSON line + rc when it produced one
+    last = (stdout or "").strip().splitlines()
+    if last:
+        print(last[-1])
+        sys.stdout.flush()
+        return p.returncode
+    return _supervisor_emit(
+        read_state(), f"bench child died rc={p.returncode} with no JSON")
 
 
 if __name__ == "__main__":

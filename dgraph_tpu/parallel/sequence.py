@@ -228,7 +228,7 @@ def ring_attention_sharded(
     the axis size; ragged sequences pad T upstream and mark real positions
     in ``kv_mask`` (static shapes are the contract everywhere in this
     framework)."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     W = mesh.shape[axis_name]

@@ -112,9 +112,17 @@ def cached_edge_plan(
     from dgraph_tpu.plan import build_edge_plan
 
     os.makedirs(cache_dir, exist_ok=True)
+    # The RESOLVED Pallas tile sizes must be part of the key: they're
+    # baked into the built plan, and build_edge_plan defaults them from
+    # the env-overridable module constants — a warm cache would otherwise
+    # silently ignore DGRAPH_TPU_SCATTER_BLOCK_E/N (ADVICE r2 #2).
+    from dgraph_tpu import plan as _plan
+
     key = _graph_fingerprint(
         edge_index,
         src_partition if dst_partition is None else np.concatenate([src_partition, dst_partition]),
+        scatter_block_e=_plan.SCATTER_BLOCK_E,
+        scatter_block_n=_plan.SCATTER_BLOCK_N,
         **{k: v for k, v in build_kwargs.items() if np.isscalar(v) or isinstance(v, str)},
     )
     path = os.path.join(cache_dir, f"plan_{key}.pkl")

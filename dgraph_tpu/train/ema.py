@@ -16,11 +16,17 @@ Usage::
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
 
 def ema_init(params):
-    """EMA state = a copy of the initial parameters."""
-    return jax.tree.map(lambda p: p, params)
+    """EMA state = a copy of the initial parameters.
+
+    A REAL copy: aliasing the live buffers (``lambda p: p``) breaks under
+    buffer donation — make_train_step's default ``donate=True`` deletes
+    the originals on the first step and the first ema_update would read
+    dead arrays (ADVICE r2 #1)."""
+    return jax.tree.map(jnp.copy, params)
 
 
 def ema_update(ema, params, decay: float = 0.999):

@@ -29,6 +29,9 @@ class Config:
     ckpt_dir: str = ""
     save_freq: int = 100
     microbenchmark: bool = False
+    # GraphCast evaluates with Polyak-averaged weights (train/ema.py);
+    # 0 disables the EMA track entirely
+    ema_decay: float = 0.999
     log_path: str = "logs/graphcast.jsonl"
     # elastic knobs (train/elastic.py): SIGTERM/SIGINT triggers a final
     # checkpoint + clean exit; a >0 deadline arms the per-step wedge
@@ -47,6 +50,7 @@ def main(cfg: Config):
     from dgraph_tpu.data.weather import SyntheticWeatherDataset
     from dgraph_tpu.models.graphcast import GraphCast, build_graphcast_graphs
     from dgraph_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
+    from dgraph_tpu.train.ema import ema_init, ema_update
     from dgraph_tpu.train.schedules import graphcast_three_phase
     from dgraph_tpu.utils import ExperimentLog, TimingReport
 
@@ -105,17 +109,33 @@ def main(cfg: Config):
     schedule = graphcast_three_phase(cfg.peak_lr, cfg.warmup_steps, cfg.decay_steps)
     opt = optax.adamw(schedule, weight_decay=0.1)
     opt_state = opt.init(params)
+    ema = ema_init(params) if cfg.ema_decay > 0 else None
     step_idx = 0
     if cfg.ckpt_dir:
-        restored = restore_checkpoint(
-            cfg.ckpt_dir, {"params": params, "opt_state": opt_state, "step": 0}
-        )
+        base = {"params": params, "opt_state": opt_state, "step": 0}
+        with_ema = dict(base, ema=ema if ema is not None else ema_init(params))
+        try:
+            restored = restore_checkpoint(
+                cfg.ckpt_dir, with_ema if ema is not None else base)
+        except Exception:
+            # checkpoint layout doesn't match this run's ema_decay config:
+            # retry with the OTHER template — a pre-EMA checkpoint under an
+            # EMA run restarts the track from the restored params; an
+            # EMA-bearing checkpoint under ema_decay=0 drops the track
+            restored = restore_checkpoint(
+                cfg.ckpt_dir, base if ema is not None else with_ema)
+            if restored:
+                if ema is not None:
+                    restored["ema"] = ema_init(restored["params"])
+                else:
+                    restored.pop("ema", None)
         if restored:
             params, opt_state, step_idx = (
                 restored["params"],
                 restored["opt_state"],
                 int(restored["step"]),
             )
+            ema = restored.get("ema", ema)
             log.write({"resumed_at_step": step_idx})
 
     def train_body(params, x, y, mask, statics_, plans_):
@@ -140,10 +160,13 @@ def main(cfg: Config):
     )
 
     @jax.jit
-    def step(params, opt_state, x, y):
+    def step(params, opt_state, ema, x, y):
         loss, grads = body(params, x, y, gmask, statics, plans)
         updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        params = optax.apply_updates(params, updates)
+        if ema is not None:  # trace-time constant (pytree vs None)
+            ema = ema_update(ema, params, cfg.ema_decay)
+        return params, opt_state, ema, loss
 
     if cfg.microbenchmark:
         _microbenchmark(model, params, statics, plans, mesh, comm, ds, log)
@@ -163,7 +186,8 @@ def main(cfg: Config):
             while step_idx < cfg.steps:
                 x, y = ds.get_sharded(step_idx)
                 t0 = time.perf_counter()
-                params, opt_state, loss = step(params, opt_state, jnp.asarray(x), jnp.asarray(y))
+                params, opt_state, ema, loss = step(
+                    params, opt_state, ema, jnp.asarray(x), jnp.asarray(y))
                 jax.block_until_ready(loss)
                 if dog is not None:
                     dog.beat()
@@ -184,12 +208,11 @@ def main(cfg: Config):
                     # the watchdog for the duration (elastic.py:_save)
                     with (dog.suspended() if dog is not None
                           else contextlib.nullcontext()):
-                        save_checkpoint(
-                            cfg.ckpt_dir,
-                            {"params": params, "opt_state": opt_state,
-                             "step": step_idx},
-                            step_idx,
-                        )
+                        state = {"params": params, "opt_state": opt_state,
+                                 "step": step_idx}
+                        if ema is not None:
+                            state["ema"] = ema
+                        save_checkpoint(cfg.ckpt_dir, state, step_idx)
                 if preempted:
                     log.write({"preempted_at_step": step_idx})
                     break

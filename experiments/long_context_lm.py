@@ -72,10 +72,25 @@ def main(cfg: Config):
         return jnp.asarray(np.concatenate([half, half]).astype(np.int32))
 
     def shard_loss(params, toks, pos):
+        # Score ALL T-1 next-token predictions, not just each shard's
+        # local T_loc-1: every shard's last position predicts the right
+        # neighbor's first token (fetched by ppermute), so the objective —
+        # and the logged loss — is identical for any world size
+        # (ADVICE r2 #3: W=1 vs W=8 curves must be comparable).
         logits = model.apply(params, toks, pos)
-        logp = jax.nn.log_softmax(logits[:-1])
-        ll = jnp.take_along_axis(logp, toks[1:, None], axis=1)[:, 0]
-        return -jax.lax.psum(ll.sum(), "graph") / (T - W)
+        left = [(i, (i - 1) % W) for i in range(W)]
+        nxt = jax.lax.ppermute(toks[:1], "graph", left)
+        targets = jnp.concatenate([toks[1:], nxt])
+        logp = jax.nn.log_softmax(logits)
+        ll = jnp.take_along_axis(logp, targets[:, None], axis=1)[:, 0]
+        # the globally-last position's "target" is the wrapped-around
+        # first token — mask it out
+        t_loc = toks.shape[0]
+        is_last = jax.lax.axis_index("graph") == W - 1
+        valid = jnp.where(
+            is_last, jnp.arange(t_loc) < t_loc - 1, jnp.ones(t_loc, bool)
+        )
+        return -jax.lax.psum((ll * valid).sum(), "graph") / (T - 1)
 
     loss_sm = jax.shard_map(
         shard_loss, mesh=mesh,

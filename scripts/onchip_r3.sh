@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-3 on-chip queue: runs the VERDICT-ordered measurements once the
+# TPU lease recovers. Logs under /root/repo/logs/.
+cd /root/repo
+exec >> logs/onchip_r3.log 2>&1
+date -u +"%Y-%m-%dT%H:%M:%SZ queue start"
+
+probe() { timeout 90 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() == 'tpu', jax.default_backend()
+float(jnp.ones((8,128)).sum())" >/dev/null 2>&1; }
+
+# 1. op profile (VERDICT #2: explain the epoch residual)
+probe && timeout 1500 python experiments/op_profile.py 2>&1 | tail -20
+date -u +"%Y-%m-%dT%H:%M:%SZ op_profile done rc=$?"
+
+# 2. kernel tile sweep (VERDICT #3)
+probe && timeout 2400 python experiments/kernel_benchmarks.py --sweep true --dtypes float32,bfloat16 2>&1 | tail -30
+date -u +"%Y-%m-%dT%H:%M:%SZ sweep done rc=$?"
+
+# 3. full bench (GCN epoch + GraphCast level 6) — supervisor makes this
+#    un-losable; budget generous since the queue owns the window
+probe && DGRAPH_BENCH_TIMEOUT=3000 python bench.py > logs/bench_r3.json 2>logs/bench_r3.err
+date -u +"%Y-%m-%dT%H:%M:%SZ bench done rc=$? $(cat logs/bench_r3.json 2>/dev/null | tail -1)"
+
+# 4. papers100M ladder: ascending fractions, stop at first failure
+#    (a success is recorded before risking an OOM at the next rung)
+for s in 0.002 0.005 0.01 0.02; do
+  probe || break
+  timeout 2400 python experiments/papers100m_gcn.py --synthetic_scale $s \
+    --epochs 3 --world_size 1 --log_path logs/p100m_step.jsonl 2>&1 | tail -5
+  rc=$?
+  date -u +"%Y-%m-%dT%H:%M:%SZ p100m scale=$s rc=$rc"
+  [ $rc -ne 0 ] && break
+done
+date -u +"%Y-%m-%dT%H:%M:%SZ queue done"
