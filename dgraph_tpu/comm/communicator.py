@@ -101,23 +101,47 @@ class _BaseComm:
         recv = lax.all_to_all(send, self.graph_axis, split_axis=0, concat_axis=0)
         return recv.reshape(W * S, F)
 
-    def seq_attention(self, q, k, v, *, causal: bool = False, kv_mask=None):
+    def seq_attention(self, q, k, v, *, causal: bool = False, kv_mask=None,
+                      impl: str = "ring"):
         """Exact attention over the axis-sharded token/vertex dimension.
 
         ``tpu`` mode runs ring attention (K/V blocks stream around the
-        graph axis via ppermute — :mod:`dgraph_tpu.parallel.sequence`);
-        ``single`` mode is the dense oracle. Same dual-impl pattern as
-        every other primitive on this facade: model code is byte-identical
-        under either comm.
+        graph axis via ppermute — :mod:`dgraph_tpu.parallel.sequence`) or,
+        with ``impl='ulysses'``, the all-to-all head-sharded variant;
+        ``single`` mode is the dense oracle. All three are exact, so model
+        code is byte-identical under any choice. Wherever a device ends up
+        holding a full-sequence view (single mode, or the Ulysses dense
+        stage), the Mosaic flash kernel takes over when enabled + the
+        shapes qualify (``config.use_flash_attention``).
 
         Args:
           q/k/v: [T_loc, H, D] per-shard (full [T, H, D] in single mode).
           kv_mask: [T_loc] 1.0 = real position (padding excluded from keys).
+          impl: 'ring' (default; O(T/W) memory, ICI neighbor hops) or
+            'ulysses' (2 all_to_alls, needs heads % axis == 0).
         """
-        from dgraph_tpu.parallel.sequence import dense_attention, ring_attention
+        from dgraph_tpu.parallel.sequence import (
+            _flash_applicable,
+            _flash_dense,
+            dense_attention,
+            ring_attention,
+            ulysses_attention,
+        )
 
+        if impl not in ("ring", "ulysses"):
+            raise ValueError(f"unknown seq_attention impl: {impl!r}")
         if self.graph_axis is None:
+            # flash here ONLY on an explicit pinned True (post-self-check):
+            # single mode is the dense ORACLE parity harnesses compare
+            # against — an unverified kernel must not replace it on auto
+            if _flash_applicable(q, require_pinned=True):
+                return _flash_dense(q, k, v, causal=causal, scale=None,
+                                    kv_mask=kv_mask)
             return dense_attention(q, k, v, causal=causal, kv_mask=kv_mask)
+        if impl == "ulysses":
+            return ulysses_attention(
+                q, k, v, self.graph_axis, causal=causal, kv_mask=kv_mask
+            )
         return ring_attention(
             q, k, v, self.graph_axis, causal=causal, kv_mask=kv_mask
         )

@@ -45,6 +45,22 @@ def pallas_fused_enabled() -> bool:
         return use_pallas_fused
     return pallas_scatter_enabled()
 
+# Mosaic flash-attention kernel for the Ulysses full-sequence per-head
+# attention (parallel/sequence.py). Tri-state like the scatter kernels:
+# None = auto (ON on TPU when shapes qualify), env DGRAPH_TPU_FLASH_ATTN
+# pins it; consumers should run flash_attention_selfcheck() on chip first
+# (same Mosaic-divergence rationale as the scatter self-checks).
+use_flash_attention: bool | None = _env_flag("DGRAPH_TPU_FLASH_ATTN", None)
+
+
+def flash_attention_enabled() -> bool:
+    if use_flash_attention is not None:
+        return use_flash_attention
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
 # Compute dtype for model matmuls (bfloat16 keeps the MXU fed; params stay
 # float32). Models resolve dtype=None through resolve_compute_dtype(), so
 # DGRAPH_TPU_COMPUTE_DTYPE=bfloat16 flips every model at once.

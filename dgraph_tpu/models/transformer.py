@@ -26,9 +26,10 @@ import jax.numpy as jnp
 class TransformerBlock(nn.Module):
     latent: int
     num_heads: int
-    comm: Any  # _BaseComm: seq_attention routes ring/dense by mode
+    comm: Any  # _BaseComm: seq_attention routes ring/ulysses/dense by mode
     dtype: Any = None
     causal: bool = True
+    attn_impl: str = "ring"  # or 'ulysses' (heads % axis == 0)
 
     @nn.compact
     def __call__(self, x):  # [T_loc, L]
@@ -45,7 +46,7 @@ class TransformerBlock(nn.Module):
         n = x.shape[0]
         attn = self.comm.seq_attention(
             q.reshape(n, Hh, dh), k.reshape(n, Hh, dh), v.reshape(n, Hh, dh),
-            causal=self.causal,
+            causal=self.causal, impl=self.attn_impl,
         )
         x = x + nn.Dense(L, dtype=dt, name="attn_out")(attn.reshape(n, L))
         y = nn.LayerNorm(dtype=dt, name="ln_ffn")(x)
@@ -65,6 +66,7 @@ class SeqTransformerLM(nn.Module):
     max_len: int = 4096
     comm: Any = None
     dtype: Any = None
+    attn_impl: str = "ring"
 
     @nn.compact
     def __call__(self, tokens, positions):  # [T_loc] int32, [T_loc] int32
@@ -73,7 +75,8 @@ class SeqTransformerLM(nn.Module):
         for i in range(self.num_layers):
             h = TransformerBlock(
                 self.latent, self.num_heads, comm=self.comm,
-                dtype=self.dtype, name=f"block_{i}",
+                dtype=self.dtype, attn_impl=self.attn_impl,
+                name=f"block_{i}",
             )(h)
         h = nn.LayerNorm(name="ln_out")(h)
         return nn.Dense(self.vocab, name="head")(h).astype(jnp.float32)

@@ -106,15 +106,35 @@ def ring_attention(
 
     q_pos = me * T + jnp.arange(T)  # [T] global query positions
 
-    def step(carry, s):
-        m, l, o, k_blk, v_blk, mask_blk = carry
+    # jax.checkpoint: AD through the scan would otherwise SAVE every
+    # step's [T, H, S] probability block (O(W * T^2 * H / W) — the exact
+    # memory wall ring attention exists to avoid); rematerializing the
+    # block math in the backward keeps saved state at the O(T/W) carries.
+    @jax.checkpoint
+    def attend(m, l, o, k_blk, v_blk, mask_blk, s):
         # the block we hold at step s originated on rank (me - s) mod W
         src = (me - s) % W
         k_pos = src * T + jnp.arange(T)  # [S] global key positions
         allowed = mask_blk[None, :] > 0
         if causal:
             allowed = allowed & (k_pos[None, :] <= q_pos[:, None])
-        m, l, o = _block_attend(qf, k_blk, v_blk, m, l, o, allowed, scale)
+        return _block_attend(qf, k_blk, v_blk, m, l, o, allowed, scale)
+
+    def step(carry, s):
+        m, l, o, k_blk, v_blk, mask_blk = carry
+        if causal:
+            # a block strictly in the query shard's future contributes
+            # nothing; skip its FLOPs entirely (on W ranks, (W-1)/2W of
+            # all ring-step blocks — the causal load-imbalance half)
+            src = (me - s) % W
+            m, l, o = lax.cond(
+                src > me,
+                lambda *a: a[:3],
+                attend,
+                m, l, o, k_blk, v_blk, mask_blk, s,
+            )
+        else:
+            m, l, o = attend(m, l, o, k_blk, v_blk, mask_blk, s)
         # rotate K/V/mask to the next rank (one ICI neighbor hop)
         perm = [(i, (i + 1) % W) for i in range(W)]
         k_blk, v_blk, mask_blk = (
@@ -206,10 +226,93 @@ def ulysses_attention(
     else:
         # every device needs the FULL-sequence mask once heads are sharded
         mask_full = lax.all_gather(kv_mask, axis_name, tiled=True)
+    if _flash_applicable(qh):
+        out = _flash_dense(qh, kh, vh, causal=causal, scale=scale,
+                           kv_mask=mask_full)
+        return head_to_seq(out)
     out = dense_attention(
         qh, kh, vh, causal=causal, scale=scale, kv_mask=mask_full
     )
     return head_to_seq(out)
+
+
+def _flash_applicable(qh: jax.Array, *, require_pinned: bool = False) -> bool:
+    """Use the Mosaic flash-attention kernel for a full-sequence dense
+    attention site?
+
+    Trace-time decision: config tri-state (``DGRAPH_TPU_FLASH_ATTN``) +
+    shape constraints of the TPU kernel (T a multiple of its 128 query
+    block, head_dim lane-friendly). ``require_pinned=True`` (the
+    single-comm ORACLE site) engages only on an explicit config True —
+    never on auto — so an unverified Mosaic kernel can't silently replace
+    the dense reference that parity harnesses compare against.
+    """
+    from dgraph_tpu import config as _cfg
+
+    if require_pinned:
+        if _cfg.use_flash_attention is not True:
+            return False
+    elif not _cfg.flash_attention_enabled():
+        return False
+    T, _, D = qh.shape
+    return T % 128 == 0 and D % 128 == 0
+
+
+def _flash_dense(qh, kh, vh, *, causal, scale, kv_mask):
+    """[T, H_loc, D] full-sequence attention via
+    ``jax.experimental.pallas.ops.tpu.flash_attention`` (forward AND
+    backward are Mosaic kernels with their own custom VJP — memory stays
+    O(T * block) instead of the [T, H, T] logits tensor). Padded tail
+    positions are excluded by giving them a second segment id."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    T, H, D = qh.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    # kernel layout: [batch, heads, T, D]
+    to_k = lambda x: x.transpose(1, 0, 2)[None]
+    seg = None
+    if kv_mask is not None:
+        ids = (kv_mask <= 0).astype(jnp.int32)[None]  # padding -> segment 1
+        seg = fa.SegmentIds(q=ids, kv=ids)
+    out = fa.flash_attention(
+        to_k(qh), to_k(kh), to_k(vh), segment_ids=seg, causal=causal,
+        sm_scale=float(scale),
+    )
+    return out[0].transpose(1, 0, 2).astype(qh.dtype)
+
+
+def flash_attention_selfcheck() -> bool:
+    """Chip-gated equivalence check vs :func:`dense_attention` (the same
+    Mosaic-divergence rationale as bench.py's scatter self-checks: the
+    kernel class is invisible to CPU CI). Call before trusting
+    ``use_flash_attention`` on a new chip/toolchain; returns False off-TPU.
+    """
+    import numpy as np
+
+    if jax.default_backend() != "tpu":
+        return False
+    rng = np.random.default_rng(3)
+    T, H, D = 256, 2, 128
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((T, H, D)), jnp.bfloat16)
+        for _ in range(3)
+    )
+    mask = jnp.asarray((np.arange(T) < T - 32).astype(np.float32))
+    try:
+        for causal in (False, True):
+            got = _flash_dense(q, k, v, causal=causal, scale=None,
+                               kv_mask=mask)
+            want = dense_attention(q, k, v, causal=causal, kv_mask=mask)
+            real = np.asarray(mask) > 0
+            if not np.allclose(
+                np.asarray(got, np.float32)[real],
+                np.asarray(want, np.float32)[real], rtol=5e-2, atol=5e-2,
+            ):
+                return False
+    except Exception:
+        return False
+    return True
 
 
 def ring_attention_sharded(

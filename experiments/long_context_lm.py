@@ -35,6 +35,10 @@ class Config:
     steps: int = 200
     lr: float = 3e-3
     world_size: Optional[int] = None  # None = all devices
+    # 'ring' (O(T/W) memory) or 'ulysses' (all-to-all head sharding; its
+    # full-sequence dense stage uses the Mosaic flash kernel on TPU when
+    # config.use_flash_attention allows AND the chip self-check passes)
+    attn_impl: str = "ring"
     seed: int = 0
     log_path: str = "logs/long_context_lm.jsonl"
     log_every: int = 20
@@ -60,9 +64,17 @@ def main(cfg: Config):
         )
     mesh = Mesh(np.array(jax.devices()[:W]), ("graph",))
     comm = Communicator.init_process_group("tpu", world_size=W)
+    from dgraph_tpu import config as fw_cfg
+    from dgraph_tpu.parallel.sequence import flash_attention_selfcheck
+
+    if fw_cfg.flash_attention_enabled():
+        # chip veto before the kernel is trusted (Mosaic divergence is
+        # invisible to CPU CI — same gate as bench.py's scatter kernels)
+        fw_cfg.set_flags(use_flash_attention=flash_attention_selfcheck())
     model = SeqTransformerLM(
         vocab=cfg.vocab, latent=cfg.latent, num_layers=cfg.num_layers,
         num_heads=cfg.num_heads, max_len=T, comm=comm,
+        attn_impl=cfg.attn_impl,
     )
     rng = np.random.default_rng(cfg.seed)
     pos = jnp.arange(T, dtype=jnp.int32)

@@ -2,6 +2,7 @@
 # Round-3 on-chip queue: runs the VERDICT-ordered measurements once the
 # TPU lease recovers. Logs under /root/repo/logs/.
 cd /root/repo
+set -o pipefail  # rc must reflect the python step, not the trailing tail
 exec >> logs/onchip_r3.log 2>&1
 date -u +"%Y-%m-%dT%H:%M:%SZ queue start"
 
@@ -32,5 +33,15 @@ for s in 0.002 0.005 0.01 0.02; do
   rc=$?
   date -u +"%Y-%m-%dT%H:%M:%SZ p100m scale=$s rc=$rc"
   [ $rc -ne 0 ] && break
+done
+# 5. long-context attention A/B on one chip: Ulysses dense stage with the
+#    Mosaic flash kernel (self-check-gated) vs the XLA dense path
+#    (seq 8192, head_dim 128 — flash shape gate satisfied)
+for fl in 0 1; do
+  probe || break
+  DGRAPH_TPU_FLASH_ATTN=$fl timeout 1200 python experiments/long_context_lm.py \
+    --seq_len 8192 --steps 30 --world_size 1 --latent 256 --num_heads 2 \
+    --attn_impl ulysses --log_path logs/lm_flash${fl}_onchip.jsonl 2>&1 | tail -2
+  date -u +"%Y-%m-%dT%H:%M:%SZ lm flash=$fl rc=$?"
 done
 date -u +"%Y-%m-%dT%H:%M:%SZ queue done"

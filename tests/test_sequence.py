@@ -198,3 +198,70 @@ def test_ulysses_kv_mask_and_grads():
     for a, b, name in zip(gu, gd, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-5, atol=5e-5, err_msg=f"d{name}")
+
+
+def test_comm_seq_attention_impl_routing():
+    """comm.seq_attention(impl=...) — ring and ulysses agree with the dense
+    oracle through the facade, and unknown impls raise."""
+    from jax.sharding import PartitionSpec as P
+
+    from dgraph_tpu.comm import Communicator
+
+    mesh = _mesh()
+    rng = np.random.default_rng(5)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((T, 8, D)), jnp.float32)
+        for _ in range(3)
+    )  # 8 heads: ulysses needs heads % axis == 0
+    comm = Communicator.init_process_group("tpu", world_size=W,
+                                           graph_axis="seq")
+    want = dense_attention(q, k, v, causal=True)
+    for impl in ("ring", "ulysses"):
+        fn = jax.shard_map(
+            lambda q, k, v: comm.seq_attention(q, k, v, causal=True,
+                                               impl=impl),
+            mesh=mesh, in_specs=(P("seq"),) * 3, out_specs=P("seq"),
+            check_vma=False,
+        )
+        with jax.set_mesh(mesh):
+            got = fn(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5,
+            err_msg=impl,
+        )
+    with pytest.raises(ValueError, match="unknown seq_attention impl"):
+        fn = jax.shard_map(
+            lambda q, k, v: comm.seq_attention(q, k, v, impl="bogus"),
+            mesh=mesh, in_specs=(P("seq"),) * 3, out_specs=P("seq"),
+            check_vma=False,
+        )
+        with jax.set_mesh(mesh):
+            fn(q, k, v)
+
+
+def test_flash_gating_off_tpu():
+    """On CPU the flash path must never engage: auto resolves by backend,
+    and even a pinned-ON flag is shape-gated (CI never traces the Mosaic
+    kernel by accident)."""
+    from dgraph_tpu import config as cfg
+    from dgraph_tpu.parallel.sequence import (
+        _flash_applicable,
+        flash_attention_selfcheck,
+    )
+
+    q = jnp.zeros((256, 2, 128), jnp.float32)
+    old = cfg.use_flash_attention
+    try:
+        cfg.set_flags(use_flash_attention=None)  # auto -> backend == tpu
+        assert _flash_applicable(q) is False
+        cfg.set_flags(use_flash_attention=True)  # pinned: shape gate rules
+        assert _flash_applicable(q) is True
+        # the single-comm oracle site engages only on the explicit pin
+        assert _flash_applicable(q, require_pinned=True) is True
+        cfg.set_flags(use_flash_attention=None)
+        assert _flash_applicable(q, require_pinned=True) is False
+        assert _flash_applicable(jnp.zeros((250, 2, 128))) is False
+        assert _flash_applicable(jnp.zeros((256, 2, 64))) is False
+    finally:
+        cfg.set_flags(use_flash_attention=old)
+    assert flash_attention_selfcheck() is False  # off-TPU: no verdict
