@@ -160,26 +160,33 @@ def _side_npad(plan: EdgePlan, side: str) -> int:
 
 
 @_scoped("dgraph.gather")
-def gather(
+@_scoped("dgraph.halo_extend")
+def halo_extend(
     x: jax.Array, plan: EdgePlan, side: str, axis_name: Optional[str]
 ) -> jax.Array:
-    """Per-edge features gathered from one endpoint side.
+    """The COMMUNICATION half of :func:`gather`: one full-width halo
+    exchange producing the extended vertex table ``local_take`` indexes
+    into ([n_pad + W*S, F] on the halo side; ``x`` unchanged elsewhere).
 
-    Parity: ``Communicator.gather`` / ``CommPlan_GatherFunction``
-    (``_torch_func_impl.py:27-110``): local vertex→edge copy + boundary
-    all_to_all + received-row placement. Here the non-halo side is a pure
-    local take; the halo side prepends one halo exchange.
-
-    Args:
-      x: [n_pad, F] per-shard vertex features for that side's vertex set.
-    Returns: [e_pad, F] per-edge features (masked edges are zero).
+    Split out so feature-chunked edge pipelines (models/gcn.py) can pay
+    the cross-rank exchange ONCE per layer at full width and chunk only
+    the local take — chunking through plain ``gather`` would re-issue the
+    all_to_all per 128-wide slice.
     """
+    if side != plan.halo_side:
+        return x
+    haloed = halo_exchange(x, plan.halo, axis_name, deltas=plan.halo_deltas)
+    return jnp.concatenate([x, haloed], axis=0)
+
+
+def local_take(full: jax.Array, plan: EdgePlan, side: str) -> jax.Array:
+    """The LOCAL half of :func:`gather`: per-edge rows taken from the
+    (already halo-extended) vertex table. No collectives; masked edges are
+    zero."""
     from dgraph_tpu import config as _cfg
 
     idx = _side_index(plan, side)
     if side == plan.halo_side:
-        haloed = halo_exchange(x, plan.halo, axis_name, deltas=plan.halo_deltas)
-        full = jnp.concatenate([x, haloed], axis=0)
         # halo-side ids are NOT monotone (local rows then halo slots); the
         # plan's sorting permutation still gives the VJP a sorted
         # segment-sum path (gather-by-perm first) when present
@@ -190,10 +197,9 @@ def gather(
                     plan.scatter_block_e, plan.scatter_block_n, plan.halo_sort_mc
                 ),
             )
-            return taken * plan.edge_mask[:, None].astype(x.dtype)
+            return taken * plan.edge_mask[:, None].astype(full.dtype)
         sorted_ids = False
     else:
-        full = x
         # owner-side ids are plan-sorted; route the VJP (a scatter-sum
         # transpose, _torch_func_impl.py:112-191) through the sorted path
         sorted_ids = plan.ids_sorted(side)
@@ -205,7 +211,25 @@ def gather(
     taken = local_ops.take_rows(
         full, idx, indices_are_sorted=sorted_ids, pallas_hints=hints
     )
-    return taken * plan.edge_mask[:, None].astype(x.dtype)
+    return taken * plan.edge_mask[:, None].astype(full.dtype)
+
+
+def gather(
+    x: jax.Array, plan: EdgePlan, side: str, axis_name: Optional[str]
+) -> jax.Array:
+    """Per-edge features gathered from one endpoint side.
+
+    Parity: ``Communicator.gather`` / ``CommPlan_GatherFunction``
+    (``_torch_func_impl.py:27-110``): local vertex→edge copy + boundary
+    all_to_all + received-row placement. Here the non-halo side is a pure
+    local take; the halo side prepends one halo exchange
+    (= :func:`halo_extend` then :func:`local_take`).
+
+    Args:
+      x: [n_pad, F] per-shard vertex features for that side's vertex set.
+    Returns: [e_pad, F] per-edge features (masked edges are zero).
+    """
+    return local_take(halo_extend(x, plan, side, axis_name), plan, side)
 
 
 @_scoped("dgraph.scatter_sum")

@@ -61,6 +61,17 @@ class _BaseComm:
     def gather(self, x, plan: EdgePlan, side: str = "src"):
         return collectives.gather(x, plan, side, self.graph_axis)
 
+    def halo_extend(self, x, plan: EdgePlan, side: str = "src"):
+        """gather's communication half: ONE full-width halo exchange ->
+        the extended vertex table. Pair with local_take to feature-chunk
+        the local work without re-issuing the collective per chunk."""
+        return collectives.halo_extend(x, plan, side, self.graph_axis)
+
+    def local_take(self, x_full, plan: EdgePlan, side: str = "src"):
+        """gather's local half (no collectives): per-edge rows from the
+        halo-extended table."""
+        return collectives.local_take(x_full, plan, side)
+
     def gather_concat(self, x_src, x_dst, plan: EdgePlan):
         return collectives.gather_concat(x_src, x_dst, plan, self.graph_axis)
 

@@ -64,6 +64,26 @@ class GraphConvLayer(nn.Module):
         # per-vertex-block bias, so the [E, F] message tensor never exists
         # (collectives.scatter_bias_relu; falls back to composed ops
         # off-TPU — same math, pinned by the equivalence tests)
+        # Feature-chunked edge pipeline: every per-edge intermediate is at
+        # most gather_col_block (128) wide. The r3 jaxpr audit showed the
+        # epoch's HBM traffic dominated by [E, D]-sized tensors that exist
+        # only as glue — the col-split gather's concat, the activation
+        # round trip — and none of them fuse past a gather/pallas_call
+        # boundary. Chunking the LOCAL work (take -> activation -> scatter
+        # per 128-wide slice) removes every edge-level concat: the only
+        # concat left is [N, D] at the vertex level (~E/N smaller). The
+        # halo exchange is hoisted to ONE full-width collective per side
+        # (comm.halo_extend) so chunking never multiplies all_to_alls.
+        # Gated on: feature-separable activation (relu — softmax-style
+        # activations normalize ACROSS features and must see full width)
+        # and a collective-free aggregation side.
+        D = self.out_features
+        cb = _cfg.gather_col_block or D
+
+        def over_chunks(fn):
+            outs = [fn(slice(j, min(j + cb, D))) for j in range(0, D, cb)]
+            return outs[0] if len(outs) == 1 else jnp.concatenate(outs, -1)
+
         if (
             self.activation is nn.relu
             and plan.homogeneous
@@ -74,10 +94,32 @@ class GraphConvLayer(nn.Module):
             )
             h_bias = h_d if owner == "dst" else h_s
             h_stream = h_s if owner == "dst" else h_d
-            e_stream = self.comm.gather(h_stream, plan, side=stream)
-            return self.comm.scatter_bias_relu(
-                e_stream, h_bias, plan, side=owner, edge_weight=edge_weight
+            h_ext = self.comm.halo_extend(h_stream, plan, side=stream)
+            return over_chunks(
+                lambda sl: self.comm.scatter_bias_relu(
+                    self.comm.local_take(h_ext[:, sl], plan, side=stream),
+                    h_bias[:, sl], plan, side=owner, edge_weight=edge_weight,
+                )
             )
+
+        separable = self.activation in (nn.relu, jax.nn.relu)
+        if separable and self.aggregate_to != plan.halo_side:
+            hs_ext = self.comm.halo_extend(h_s, plan, side="src")
+            hd_ext = self.comm.halo_extend(h_d, plan, side="dst")
+
+            def chunked(sl):
+                m = self.comm.local_take(
+                    hs_ext[:, sl], plan, side="src"
+                ) + self.comm.local_take(hd_ext[:, sl], plan, side="dst")
+                m = self.activation(m)
+                if edge_weight is not None:
+                    m = m * edge_weight[:, None]
+                return self.comm.scatter_sum(m, plan, side=self.aggregate_to)
+
+            return over_chunks(chunked)
+
+        # full-width fallback: non-separable activation or halo-side
+        # aggregation (chunking would repeat the reverse exchange)
         m = self.comm.gather(h_s, plan, side="src") + self.comm.gather(
             h_d, plan, side="dst"
         )

@@ -28,8 +28,27 @@ class SAGEConv(nn.Module):
         from dgraph_tpu import config as _cfg
 
         dt = _cfg.resolve_compute_dtype(self.dtype)
-        h_src = self.comm.gather(x, plan, side="src")  # [e_pad, F]
-        agg = self.comm.scatter_sum(h_src, plan, side="dst")  # [n_pad, F]
+        F = x.shape[-1]
+        cb = _cfg.gather_col_block or F
+        if plan.halo_side != "dst" and F > cb:
+            # feature-chunked neighbor sum (models/gcn.py rationale): the
+            # per-edge op here is IDENTITY, so chunking is exact for any
+            # activation; one full-width halo exchange, local work in
+            # <=cb-wide slices, concat only at the vertex level
+            x_ext = self.comm.halo_extend(x, plan, side="src")
+            agg = jnp.concatenate(
+                [
+                    self.comm.scatter_sum(
+                        self.comm.local_take(x_ext[:, j:j + cb], plan, side="src"),
+                        plan, side="dst",
+                    )
+                    for j in range(0, F, cb)
+                ],
+                axis=-1,
+            )
+        else:
+            h_src = self.comm.gather(x, plan, side="src")  # [e_pad, F]
+            agg = self.comm.scatter_sum(h_src, plan, side="dst")  # [n_pad, F]
         ones = plan.edge_mask[:, None]
         deg = self.comm.scatter_sum(ones, plan, side="dst")  # [n_pad, 1]
         mean_nbr = agg / jnp.maximum(deg, 1.0)

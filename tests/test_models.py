@@ -224,3 +224,57 @@ def test_multilabel_float_targets_train(mesh8, sbm):
     )
     assert history[-1]["loss"] < history[0]["loss"]
     assert np.isfinite(history[-1]["loss"])
+
+
+def test_chunked_pipeline_one_exchange_per_layer(mesh8, sbm):
+    """Structural pin for the feature-chunked edge pipeline: hidden width
+    256 = 2 chunks per layer, but the halo all_to_all count must stay ONE
+    per conv layer (comm.halo_extend hoists it out of the chunk loop) —
+    chunking must never multiply collectives."""
+    from jax.sharding import PartitionSpec as P
+
+    from dgraph_tpu.comm.mesh import GRAPH_AXIS, plan_in_specs, squeeze_plan
+
+    g = build_graphs(sbm, 8)
+    comm = Communicator.init_process_group("tpu", world_size=8)
+    model = GCN(hidden_features=256, out_features=4, comm=comm, num_layers=2)
+    plan = jax.tree.map(jnp.asarray, g.plan)
+    x = jnp.asarray(g.features)
+    ew = jnp.asarray(g.edge_weight)
+    params = jax.eval_shape(
+        lambda: jax.shard_map(
+            lambda p_, x_, e_: model.init(jax.random.key(0), x_[0],
+                                          squeeze_plan(p_), e_[0]),
+            mesh=mesh8,
+            in_specs=(plan_in_specs(plan), P(GRAPH_AXIS), P(GRAPH_AXIS)),
+            out_specs=P(),
+        )(plan, x, ew)
+    )
+    params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), params)
+
+    fwd = jax.shard_map(
+        lambda pp, p_, x_, e_: model.apply(pp, x_[0], squeeze_plan(p_),
+                                           e_[0])[None],
+        mesh=mesh8,
+        in_specs=(P(), plan_in_specs(plan), P(GRAPH_AXIS), P(GRAPH_AXIS)),
+        out_specs=P(GRAPH_AXIS),
+    )
+    jaxpr = jax.make_jaxpr(fwd)(params, plan, x, ew)
+
+    def count(j, name):
+        n = 0
+        for e in j.eqns:
+            n += name in e.primitive.name
+            for p in e.params.values():
+                for item in (p if isinstance(p, (list, tuple)) else [p]):
+                    if hasattr(item, "jaxpr"):
+                        n += count(getattr(item.jaxpr, "jaxpr", item.jaxpr),
+                                   name)
+                    elif hasattr(item, "eqns"):
+                        n += count(item, name)
+        return n
+
+    n_a2a = count(jaxpr.jaxpr, "all_to_all")
+    # 2 conv layers x 1 halo side each = 2 exchanges in the forward (the
+    # stream side is the halo side here; the bias side is local)
+    assert n_a2a <= 2, f"chunking multiplied collectives: {n_a2a} all_to_alls"
