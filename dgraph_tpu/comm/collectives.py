@@ -159,7 +159,20 @@ def _side_npad(plan: EdgePlan, side: str) -> int:
     return plan.n_src_pad if side == "src" else plan.n_dst_pad
 
 
-@_scoped("dgraph.gather")
+def map_feature_chunks(fn, width: int, chunk: Optional[int] = None):
+    """Scaffold of the feature-chunked edge pipeline (models/gcn.py
+    rationale): apply ``fn(slice)`` over <=chunk-wide feature slices and
+    concat the results on the last axis. ``chunk`` defaults to
+    ``config.gather_col_block``. Callers are responsible for the gates
+    (feature-separable per-edge math, collective-free per-chunk ops —
+    pair with :func:`halo_extend` + :func:`local_take`)."""
+    from dgraph_tpu import config as _cfg
+
+    cb = chunk or _cfg.gather_col_block or width
+    outs = [fn(slice(j, min(j + cb, width))) for j in range(0, width, cb)]
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=-1)
+
+
 @_scoped("dgraph.halo_extend")
 def halo_extend(
     x: jax.Array, plan: EdgePlan, side: str, axis_name: Optional[str]
@@ -179,6 +192,7 @@ def halo_extend(
     return jnp.concatenate([x, haloed], axis=0)
 
 
+@_scoped("dgraph.local_take")
 def local_take(full: jax.Array, plan: EdgePlan, side: str) -> jax.Array:
     """The LOCAL half of :func:`gather`: per-edge rows taken from the
     (already halo-extended) vertex table. No collectives; masked edges are
@@ -214,6 +228,7 @@ def local_take(full: jax.Array, plan: EdgePlan, side: str) -> jax.Array:
     return taken * plan.edge_mask[:, None].astype(full.dtype)
 
 
+@_scoped("dgraph.gather")
 def gather(
     x: jax.Array, plan: EdgePlan, side: str, axis_name: Optional[str]
 ) -> jax.Array:

@@ -44,31 +44,50 @@ class GATConv(nn.Module):
         w = nn.Dense(H * D, use_bias=False, name="proj", dtype=dt)
         hx = w(x).reshape(-1, H, D)  # [n_pad, H, D]
 
-        # per-edge endpoint features: src via halo gather, dst local
-        h_src = self.comm.gather(hx.reshape(-1, H * D), plan, side="src").reshape(
-            -1, H, D
-        )
-        h_dst = self.comm.gather(hx.reshape(-1, H * D), plan, side="dst").reshape(
-            -1, H, D
-        )
-
         a_src = self.param("att_src", nn.initializers.glorot_uniform(), (H, D))
         a_dst = self.param("att_dst", nn.initializers.glorot_uniform(), (H, D))
         # cast params to the compute dtype: f32 attention params would
         # promote the [e_pad, H, D] tensors (the HBM-dominant ones) back
         # to f32 and forfeit the bf16 bandwidth win
-        a_src = a_src.astype(h_src.dtype)
-        a_dst = a_dst.astype(h_dst.dtype)
-        logits = (h_src * a_src).sum(-1) + (h_dst * a_dst).sum(-1)  # [e_pad, H]
-        logits = nn.leaky_relu(logits, self.negative_slope)
+        a_src = a_src.astype(hx.dtype)
+        a_dst = a_dst.astype(hx.dtype)
 
-        # local softmax over incoming edges of each dst vertex
-        alpha = local_ops.segment_softmax(
-            logits, plan.dst_index, plan.n_dst_pad, plan.edge_mask,
-            indices_are_sorted=plan.ids_sorted("dst"),
-        )  # [e_pad, H]
-        msg = (alpha[..., None] * h_src).reshape(-1, H * D)
-        out = self.comm.scatter_sum(msg, plan, side="dst").reshape(-1, H, D)
+        def head_group(hs_c, hd_c, a_s, a_d):
+            """Attention for a contiguous head group — heads are fully
+            independent (per-head logits, per-head softmax), so the math
+            is exact for any grouping (models/gcn.py chunking rationale:
+            keeps every [e_pad, *] intermediate <= gather_col_block wide)."""
+            logits = (hs_c * a_s).sum(-1) + (hd_c * a_d).sum(-1)  # [e_pad, Hg]
+            logits = nn.leaky_relu(logits, self.negative_slope)
+            # local softmax over incoming edges of each dst vertex
+            alpha = local_ops.segment_softmax(
+                logits, plan.dst_index, plan.n_dst_pad, plan.edge_mask,
+                indices_are_sorted=plan.ids_sorted("dst"),
+            )  # [e_pad, Hg]
+            hg = hs_c.shape[1]
+            msg = (alpha[..., None] * hs_c).reshape(-1, hg * D)
+            return self.comm.scatter_sum(msg, plan, side="dst").reshape(
+                -1, hg, D)
+
+        from dgraph_tpu.comm.collectives import map_feature_chunks
+
+        # heads per chunk: head groups are the chunking granularity (the
+        # softmax couples features within a head, never across heads);
+        # halo_side == "src" is guaranteed by the guard above
+        gh = max(1, (_cfg.gather_col_block or H * D) // D)
+        flat = hx.reshape(-1, H * D)
+        hx_ext = self.comm.halo_extend(flat, plan, side="src")
+
+        def group(sl):
+            h0, h1 = sl.start // D, sl.stop // D
+            hs_c = self.comm.local_take(
+                hx_ext[:, sl], plan, side="src").reshape(-1, h1 - h0, D)
+            hd_c = self.comm.local_take(
+                flat[:, sl], plan, side="dst").reshape(-1, h1 - h0, D)
+            agg = head_group(hs_c, hd_c, a_src[h0:h1], a_dst[h0:h1])
+            return agg.reshape(-1, (h1 - h0) * D)
+
+        out = map_feature_chunks(group, H * D, chunk=gh * D).reshape(-1, H, D)
         out = out.mean(axis=1)  # head-mean (reference RGAT uses concat+proj; mean keeps D)
         if self.residual:
             out = out + nn.Dense(D, use_bias=False, name="res", dtype=dt)(x)

@@ -77,12 +77,12 @@ class GraphConvLayer(nn.Module):
         # Gated on: feature-separable activation (relu — softmax-style
         # activations normalize ACROSS features and must see full width)
         # and a collective-free aggregation side.
+        from dgraph_tpu.comm.collectives import map_feature_chunks
+
         D = self.out_features
-        cb = _cfg.gather_col_block or D
 
         def over_chunks(fn):
-            outs = [fn(slice(j, min(j + cb, D))) for j in range(0, D, cb)]
-            return outs[0] if len(outs) == 1 else jnp.concatenate(outs, -1)
+            return map_feature_chunks(fn, D)
 
         if (
             self.activation is nn.relu

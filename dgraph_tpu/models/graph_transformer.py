@@ -56,11 +56,29 @@ class GPSLayer(nn.Module):
         y = nn.LayerNorm(dtype=dt, name="ln_local")(x)
         h_s = nn.Dense(L, use_bias=False, dtype=dt, name="src_proj")(y)
         h_d = nn.Dense(L, dtype=dt, name="dst_proj")(y)
-        m = nn.silu(
-            self.comm.gather(h_s, plan, side="src")
-            + self.comm.gather(h_d, plan, side="dst")
-        )
-        local = self.comm.scatter_sum(m, plan, side="dst")
+        from dgraph_tpu.comm.collectives import map_feature_chunks
+
+        if plan.halo_side != "dst":
+            # feature-chunked local pipeline (models/gcn.py rationale):
+            # silu is elementwise, so chunking is exact; one full-width
+            # halo exchange, every [E, *] intermediate <= col_block wide
+            hs_ext = self.comm.halo_extend(h_s, plan, side="src")
+            local = map_feature_chunks(
+                lambda sl: self.comm.scatter_sum(
+                    nn.silu(
+                        self.comm.local_take(hs_ext[:, sl], plan, side="src")
+                        + self.comm.local_take(h_d[:, sl], plan, side="dst")
+                    ),
+                    plan, side="dst",
+                ),
+                L,
+            )
+        else:
+            m = nn.silu(
+                self.comm.gather(h_s, plan, side="src")
+                + self.comm.gather(h_d, plan, side="dst")
+            )
+            local = self.comm.scatter_sum(m, plan, side="dst")
         x = x + nn.Dense(L, dtype=dt, name="local_out")(local)
 
         # --- global branch: ring attention over the vertex dimension ---

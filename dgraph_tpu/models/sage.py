@@ -27,24 +27,22 @@ class SAGEConv(nn.Module):
     def __call__(self, x: jax.Array, plan: EdgePlan) -> jax.Array:
         from dgraph_tpu import config as _cfg
 
+        from dgraph_tpu.comm.collectives import map_feature_chunks
+
         dt = _cfg.resolve_compute_dtype(self.dtype)
         F = x.shape[-1]
-        cb = _cfg.gather_col_block or F
-        if plan.halo_side != "dst" and F > cb:
+        if plan.halo_side != "dst":
             # feature-chunked neighbor sum (models/gcn.py rationale): the
             # per-edge op here is IDENTITY, so chunking is exact for any
             # activation; one full-width halo exchange, local work in
-            # <=cb-wide slices, concat only at the vertex level
+            # <=col_block-wide slices, concat only at the vertex level
             x_ext = self.comm.halo_extend(x, plan, side="src")
-            agg = jnp.concatenate(
-                [
-                    self.comm.scatter_sum(
-                        self.comm.local_take(x_ext[:, j:j + cb], plan, side="src"),
-                        plan, side="dst",
-                    )
-                    for j in range(0, F, cb)
-                ],
-                axis=-1,
+            agg = map_feature_chunks(
+                lambda sl: self.comm.scatter_sum(
+                    self.comm.local_take(x_ext[:, sl], plan, side="src"),
+                    plan, side="dst",
+                ),
+                F,
             )
         else:
             h_src = self.comm.gather(x, plan, side="src")  # [e_pad, F]
