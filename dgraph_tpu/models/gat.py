@@ -17,7 +17,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from dgraph_tpu.ops import local as local_ops
+from dgraph_tpu.models.message_passing import head_chunked_attention
 from dgraph_tpu.plan import EdgePlan
 
 
@@ -52,42 +52,10 @@ class GATConv(nn.Module):
         a_src = a_src.astype(hx.dtype)
         a_dst = a_dst.astype(hx.dtype)
 
-        def head_group(hs_c, hd_c, a_s, a_d):
-            """Attention for a contiguous head group — heads are fully
-            independent (per-head logits, per-head softmax), so the math
-            is exact for any grouping (models/gcn.py chunking rationale:
-            keeps every [e_pad, *] intermediate <= gather_col_block wide)."""
-            logits = (hs_c * a_s).sum(-1) + (hd_c * a_d).sum(-1)  # [e_pad, Hg]
-            logits = nn.leaky_relu(logits, self.negative_slope)
-            # local softmax over incoming edges of each dst vertex
-            alpha = local_ops.segment_softmax(
-                logits, plan.dst_index, plan.n_dst_pad, plan.edge_mask,
-                indices_are_sorted=plan.ids_sorted("dst"),
-            )  # [e_pad, Hg]
-            hg = hs_c.shape[1]
-            msg = (alpha[..., None] * hs_c).reshape(-1, hg * D)
-            return self.comm.scatter_sum(msg, plan, side="dst").reshape(
-                -1, hg, D)
-
-        from dgraph_tpu.comm.collectives import map_feature_chunks
-
-        # heads per chunk: head groups are the chunking granularity (the
-        # softmax couples features within a head, never across heads);
-        # halo_side == "src" is guaranteed by the guard above
-        gh = max(1, (_cfg.gather_col_block or H * D) // D)
         flat = hx.reshape(-1, H * D)
-        hx_ext = self.comm.halo_extend(flat, plan, side="src")
-
-        def group(sl):
-            h0, h1 = sl.start // D, sl.stop // D
-            hs_c = self.comm.local_take(
-                hx_ext[:, sl], plan, side="src").reshape(-1, h1 - h0, D)
-            hd_c = self.comm.local_take(
-                flat[:, sl], plan, side="dst").reshape(-1, h1 - h0, D)
-            agg = head_group(hs_c, hd_c, a_src[h0:h1], a_dst[h0:h1])
-            return agg.reshape(-1, (h1 - h0) * D)
-
-        out = map_feature_chunks(group, H * D, chunk=gh * D).reshape(-1, H, D)
+        out = head_chunked_attention(
+            self.comm, flat, flat, a_src, a_dst, plan, self.negative_slope
+        )
         out = out.mean(axis=1)  # head-mean (reference RGAT uses concat+proj; mean keeps D)
         if self.residual:
             out = out + nn.Dense(D, use_bias=False, name="res", dtype=dt)(x)

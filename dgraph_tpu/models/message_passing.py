@@ -20,6 +20,51 @@ import jax.numpy as jnp
 from dgraph_tpu.plan import EdgePlan
 
 
+def head_chunked_attention(
+    comm, hs, hd, a_src, a_dst, plan, negative_slope: float
+) -> jax.Array:
+    """GAT-style per-dst-vertex softmax attention, chunked by head groups.
+
+    The ONE copy of the attention edge pipeline shared by GATConv and
+    RGAT's RelationalAttention: per-head logits + leaky-relu + rank-local
+    segment softmax + weighted scatter, with heads processed in groups of
+    ``gather_col_block // D`` so every [e_pad, *] intermediate stays
+    <= col_block wide (the models/gcn.py chunking rationale; softmax
+    couples features within a head, never across heads, so grouping is
+    exact). Requires dst-owned edges (halo_side == 'src'); both callers
+    guard this.
+
+    Args:
+      hs/hd: [n_pad, H*D] src-/dst-side projections.
+      a_src/a_dst: [H, D] attention parameters (already compute-dtype).
+    Returns: [n_dst_pad, H, D] attended sums.
+    """
+    from dgraph_tpu import config as _cfg
+    from dgraph_tpu.comm.collectives import map_feature_chunks
+    from dgraph_tpu.ops import local as local_ops
+
+    H, D = a_src.shape
+    gh = max(1, (_cfg.gather_col_block or H * D) // D)  # heads per chunk
+    hs_ext = comm.halo_extend(hs, plan, side="src")
+
+    def group(sl):
+        h0, h1 = sl.start // D, sl.stop // D
+        hs_c = comm.local_take(
+            hs_ext[:, sl], plan, side="src").reshape(-1, h1 - h0, D)
+        hd_c = comm.local_take(
+            hd[:, sl], plan, side="dst").reshape(-1, h1 - h0, D)
+        logits = (hs_c * a_src[h0:h1]).sum(-1) + (hd_c * a_dst[h0:h1]).sum(-1)
+        logits = nn.leaky_relu(logits, negative_slope)
+        alpha = local_ops.segment_softmax(
+            logits, plan.dst_index, plan.n_dst_pad, plan.edge_mask,
+            indices_are_sorted=plan.ids_sorted("dst"),
+        )
+        msg = (alpha[..., None] * hs_c).reshape(-1, (h1 - h0) * D)
+        return comm.scatter_sum(msg, plan, side="dst")
+
+    return map_feature_chunks(group, H * D, chunk=gh * D).reshape(-1, H, D)
+
+
 class MessagePassing(nn.Module):
     """halo-exchange -> [local ; halo] -> ``layer_fn(full, plan)``.
 

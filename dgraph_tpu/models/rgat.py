@@ -21,7 +21,6 @@ import jax
 import jax.numpy as jnp
 
 from dgraph_tpu.models.norm import DistributedBatchNorm
-from dgraph_tpu.ops import local as local_ops
 
 
 class RelationalAttention(nn.Module):
@@ -50,24 +49,20 @@ class RelationalAttention(nn.Module):
         H, D = self.num_heads, self.out_features
         hs = nn.Dense(H * D, use_bias=False, name="src_proj", dtype=dt)(x_src)
         hd = nn.Dense(H * D, use_bias=False, name="dst_proj", dtype=dt)(x_dst)
-        h_src = self.comm.gather(hs, plan, side="src").reshape(-1, H, D)
-        h_dst = self.comm.gather(hd, plan, side="dst").reshape(-1, H, D)
         a_src = self.param("att_src", nn.initializers.glorot_uniform(), (H, D))
         a_dst = self.param("att_dst", nn.initializers.glorot_uniform(), (H, D))
         # cast params to the compute dtype: f32 attention params would
         # promote the [e_pad, H, D] tensors (the HBM-dominant ones) back
         # to f32 and forfeit the bf16 bandwidth win
-        a_src = a_src.astype(h_src.dtype)
-        a_dst = a_dst.astype(h_dst.dtype)
-        logits = (h_src * a_src).sum(-1) + (h_dst * a_dst).sum(-1)
-        logits = nn.leaky_relu(logits, self.negative_slope)
-        alpha = local_ops.segment_softmax(
-            logits, plan.dst_index, plan.n_dst_pad, plan.edge_mask,
-            indices_are_sorted=plan.ids_sorted("dst"),
+        a_src = a_src.astype(hs.dtype)
+        a_dst = a_dst.astype(hd.dtype)
+
+        from dgraph_tpu.models.message_passing import head_chunked_attention
+
+        out = head_chunked_attention(
+            self.comm, hs, hd, a_src, a_dst, plan, self.negative_slope
         )
-        msg = (alpha[..., None] * h_src).reshape(-1, H * D)
-        out = self.comm.scatter_sum(msg, plan, side="dst")
-        return out.reshape(-1, H, D).mean(axis=1)
+        return out.mean(axis=1)
 
 
 class RGATLayer(nn.Module):

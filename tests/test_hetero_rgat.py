@@ -62,13 +62,17 @@ def test_relation_plans_cover_all_edges(mag):
         assert float(np.asarray(g.plans[key].edge_mask).sum()) == edges.shape[1]
 
 
-def test_rgat_distributed_matches_single(mesh8, mag):
+@pytest.mark.parametrize("hidden,heads", [(16, 2), (64, 4)])
+def test_rgat_distributed_matches_single(mesh8, mag, hidden, heads):
+    # (64, 4): H*D = 256 > gather_col_block, so the head-group-chunked
+    # attention path ENGAGES (the small config covers single-group)
     g1, g8 = build(mag, 1), build(mag, 8)
     rels = list(g8.plans)
     comm1 = Communicator.init_process_group("single")
     comm8 = Communicator.init_process_group("tpu", world_size=8)
     kw = dict(
-        hidden_features=16, out_features=4, relations=rels, num_layers=2, num_heads=2
+        hidden_features=hidden, out_features=4, relations=rels, num_layers=2,
+        num_heads=heads,
     )
     m1 = RGAT(comm=comm1, **kw)
     m8 = RGAT(comm=comm8, **kw)
