@@ -31,12 +31,6 @@ class GATConv(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, plan: EdgePlan) -> jax.Array:
-        if plan.halo_side != "src":
-            raise ValueError(
-                "GATConv requires dst-owned edges (halo_side='src') so the "
-                "attention softmax is rank-local; build the plan with "
-                "edge_owner='dst'"
-            )
         from dgraph_tpu import config as _cfg
 
         dt = _cfg.resolve_compute_dtype(self.dtype)

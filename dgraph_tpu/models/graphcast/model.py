@@ -41,15 +41,28 @@ class MeshEdgeBlock(nn.Module):
     @nn.compact
     def __call__(self, e, x_src, x_dst, plan):
         L = self.latent
-        h_s = self.comm.gather(
-            nn.Dense(L, use_bias=False, name="src_proj", dtype=self.dtype)(x_src),
-            plan, side="src",
+        hs = nn.Dense(L, use_bias=False, name="src_proj", dtype=self.dtype)(x_src)
+        hd = nn.Dense(L, use_bias=False, name="dst_proj", dtype=self.dtype)(x_dst)
+        e_proj = nn.Dense(L, name="edge_proj", dtype=self.dtype)(e)
+        # feature-chunked first stage (models/gcn.py rationale): silu and
+        # the 3-way add are elementwise, so each <=col_block-wide slice is
+        # computed independently from chunk-wide takes — the two
+        # per-gather col-split concats collapse into the single [E, L] h
+        # tensor the MLP needs anyway. halo_extend is the identity on the
+        # non-halo side, so ONE exchange happens regardless of which side
+        # carries the halo.
+        from dgraph_tpu.comm.collectives import map_feature_chunks
+
+        hs_ext = self.comm.halo_extend(hs, plan, side="src")
+        hd_ext = self.comm.halo_extend(hd, plan, side="dst")
+        h = map_feature_chunks(
+            lambda sl: nn.silu(
+                e_proj[:, sl]
+                + self.comm.local_take(hs_ext[:, sl], plan, side="src")
+                + self.comm.local_take(hd_ext[:, sl], plan, side="dst")
+            ),
+            L,
         )
-        h_d = self.comm.gather(
-            nn.Dense(L, use_bias=False, name="dst_proj", dtype=self.dtype)(x_dst),
-            plan, side="dst",
-        )
-        h = nn.silu(nn.Dense(L, name="edge_proj", dtype=self.dtype)(e) + h_s + h_d)
         upd = MLP([self.latent], use_layer_norm=True, dtype=self.dtype)(h)
         return e + upd
 

@@ -43,6 +43,15 @@ def head_chunked_attention(
     from dgraph_tpu.comm.collectives import map_feature_chunks
     from dgraph_tpu.ops import local as local_ops
 
+    if plan.halo_side != "src":
+        raise ValueError(
+            "head_chunked_attention requires dst-owned edges "
+            "(halo_side='src'): with src-owned plans the dst index uses "
+            "halo-slot numbering, so a rank-local softmax over n_dst_pad "
+            "segments would silently drop remote contributions from the "
+            "normalizer"
+        )
+
     H, D = a_src.shape
     gh = max(1, (_cfg.gather_col_block or H * D) // D)  # heads per chunk
     hs_ext = comm.halo_extend(hs, plan, side="src")

@@ -35,14 +35,6 @@ class RelationalAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x_src: jax.Array, x_dst: jax.Array, plan) -> jax.Array:
-        if plan.halo_side != "src":
-            raise ValueError(
-                "RelationalAttention requires dst-owned edges "
-                "(halo_side='src'): with src-owned plans the dst index uses "
-                "halo-slot numbering, so a rank-local softmax over "
-                "n_dst_pad segments would silently drop remote "
-                "contributions from the normalizer"
-            )
         from dgraph_tpu import config as _cfg
 
         dt = _cfg.resolve_compute_dtype(self.dtype)

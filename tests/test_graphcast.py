@@ -108,12 +108,15 @@ def plans_of(g, sel):
     }
 
 
-def test_graphcast_distributed_matches_single(mesh8, graphs1, graphs8):
+@pytest.mark.parametrize("latent", [16, 192])
+def test_graphcast_distributed_matches_single(mesh8, graphs1, graphs8, latent):
+    # latent=192 > gather_col_block: the MeshEdgeBlock chunked first stage
+    # runs MULTI-chunk, with a 64-wide remainder slice (128 + 64)
     from dgraph_tpu.data.weather import SyntheticWeatherDataset
 
     comm1 = Communicator.init_process_group("single")
     comm8 = Communicator.init_process_group("tpu", world_size=8)
-    kw = dict(latent=16, processor_layers=2, out_channels=CH)
+    kw = dict(latent=latent, processor_layers=2, out_channels=CH)
     m1 = GraphCast(comm=comm1, **kw)
     m8 = GraphCast(comm=comm8, **kw)
 
