@@ -31,8 +31,8 @@ def head_chunked_attention(
     ``gather_col_block // D`` so every [e_pad, *] intermediate stays
     <= col_block wide (the models/gcn.py chunking rationale; softmax
     couples features within a head, never across heads, so grouping is
-    exact). Requires dst-owned edges (halo_side == 'src'); both callers
-    guard this.
+    exact). Enforces dst-owned edges (halo_side == 'src') itself — a
+    src-owned plan would make the rank-local softmax silently wrong.
 
     Args:
       hs/hd: [n_pad, H*D] src-/dst-side projections.

@@ -97,12 +97,20 @@ named_scope = jax.named_scope
 
 
 def salt_input(a, salt):
-    """Fold a scan-carry scalar into an op input WITHOUT changing its value
-    or dtype: ``a + cast(salt)*0`` keeps a data dependence (XLA cannot fold
-    x*0 for floats — NaN/Inf semantics) so scan iterations serialize, and
-    the cast avoids promoting bf16 inputs to the f32 carry dtype (which
-    would silently benchmark f32 kernels)."""
-    return a + salt.astype(a.dtype) * 0
+    """Fold a scan-carry scalar into an op input with no meaningful value
+    change: ``a + cast(salt * 1e-20)`` keeps a LIVE data dependence on the
+    loop carry so scan iterations serialize and XLA cannot hoist the op
+    out of the timing loop. The scale makes the perturbation ~1e-18 on
+    O(1) inputs — numerically invisible — and the cast avoids promoting
+    bf16 inputs to the f32 carry dtype (which would silently benchmark
+    f32 kernels).
+
+    Previously ``cast(salt) * 0``: XLA's simplifier folded that to a
+    constant despite float NaN/Inf semantics, severed the chain, and
+    loop-invariant code motion hoisted the op — producing impossible
+    ~0 ms "measurements" (caught in r3 via a 0.011 ms 240k-row gather).
+    """
+    return a + (salt * 1e-20).astype(a.dtype)
 
 
 def timed_scan_ms(fn, *, reps: int = 3, n_long: int = 8):
@@ -127,7 +135,13 @@ def timed_scan_ms(fn, *, reps: int = 3, n_long: int = 8):
     def loop(s, n):
         def body(acc, _):
             out = fn(acc)
-            return acc + out.ravel()[0].astype(jnp.float32) * 1e-20, None
+            # consume the WHOLE output: a single-element fetch
+            # (out.ravel()[0]) lets XLA slice through sliceable ops —
+            # a row gather collapses to gathering ONE row and the
+            # "measurement" is ~0 (caught in r3: a 9 TB/s CPU gather).
+            # The sum can still fuse into the producer (output writes may
+            # be elided), but every input byte is genuinely read.
+            return acc + out.astype(jnp.float32).sum() * 1e-20, None
 
         acc, _ = jax.lax.scan(body, s, None, length=n)
         return acc

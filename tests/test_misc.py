@@ -195,3 +195,34 @@ def test_ema_update():
     expect = 1.0 - 0.9 ** 10
     np.testing.assert_allclose(np.asarray(ema["w"]), expect, rtol=1e-6)
     np.testing.assert_allclose(np.asarray(ema["b"]), expect, rtol=1e-6)
+
+
+def test_timed_scan_actually_measures_the_op():
+    """Regression pin for the r3 measurement-integrity fix: the scan
+    protocol must consume the WHOLE op output with a live carry
+    dependency. Before the fix, XLA sliced through the single-element
+    fetch (a row gather collapsed to one row) and constant-folded the
+    `salt * 0` chain, so a 50000x-bigger op measured the same ~0 ms.
+    A 3x time-ratio floor is far below the real ~1000x+ but far above
+    the broken-case ratio (~1x)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from dgraph_tpu.utils.timing import salt_input, timed_scan_ms
+
+    rng = np.random.default_rng(0)
+    big = jnp.asarray(rng.standard_normal((400_000, 128)), jnp.float32)
+    idx_big = jnp.asarray(rng.integers(0, 400_000, 400_000), jnp.int32)
+    idx_one = idx_big[:8]
+
+    t_big = timed_scan_ms(
+        lambda s: salt_input(big, s)[idx_big], reps=3, n_long=6)
+    t_one = timed_scan_ms(
+        lambda s: salt_input(big, s)[idx_one], reps=3, n_long=6)
+    assert t_big is not None
+    # t_one can be None (too fast for a positive delta) — that's fine;
+    # the broken case made t_big equally immeasurable
+    floor = 3 * (t_one or 0.05)
+    assert t_big > floor, (t_big, t_one)
