@@ -47,18 +47,38 @@ class SyntheticWeatherDataset:
                     amp = rng.normal(0, 1.0)
                     fields[:, :, c] += amp * np.sin(kl * lat + ph) * np.cos(kk * lon)
             x = fields.reshape(n_grid, num_channels)
-            # deterministic target: eastward roll + mild decay + channel mix
-            rolled = np.roll(fields, shift=3, axis=1).reshape(n_grid, num_channels)
-            y = 0.9 * rolled + 0.1 * x.mean(axis=1, keepdims=True)
+            y = self._advance(x)
             self._samples.append((x.astype(np.float32), y.astype(np.float32)))
+
+    def _advance(self, x: np.ndarray) -> np.ndarray:
+        """The dataset's deterministic dynamics T: eastward roll + mild
+        decay + channel mix. Iterating T gives true multi-step
+        trajectories for rollout evaluation."""
+        fields = x.reshape(self.num_lat, self.num_lon, self.num_channels)
+        rolled = np.roll(fields, shift=3, axis=1).reshape(x.shape)
+        return (0.9 * rolled + 0.1 * x.mean(axis=1, keepdims=True)).astype(
+            np.float32)
 
     def __len__(self):
         return len(self._samples)
 
+    def _shard(self, a: np.ndarray):
+        g = self.graphs
+        return shard_vertex_data(a[g.grid_ren.inv], g.grid_ren.counts, g.n_grid_pad)
+
     def get_sharded(self, i: int):
         """(input, target) as [W, n_grid_pad, C] plan-layout arrays."""
         x, y = self._samples[i % len(self._samples)]
-        g = self.graphs
-        xs = shard_vertex_data(x[g.grid_ren.inv], g.grid_ren.counts, g.n_grid_pad)
-        ys = shard_vertex_data(y[g.grid_ren.inv], g.grid_ren.counts, g.n_grid_pad)
-        return xs, ys
+        return self._shard(x), self._shard(y)
+
+    def trajectory_sharded(self, i: int, num_steps: int):
+        """(x0, [T, W, n_grid_pad, C]) — the true num_steps-long forward
+        trajectory T(x0), T^2(x0), ... for rollout evaluation
+        (models.graphcast.rollout)."""
+        x, _ = self._samples[i % len(self._samples)]
+        steps = []
+        cur = x
+        for _ in range(num_steps):
+            cur = self._advance(cur)
+            steps.append(self._shard(cur))
+        return self._shard(x), np.stack(steps)

@@ -1,6 +1,11 @@
 from dgraph_tpu.models.graphcast.mesh import build_multimesh, icosahedron, MultiMesh
 from dgraph_tpu.models.graphcast.graph import GraphCastGraphs, build_graphcast_graphs
-from dgraph_tpu.models.graphcast.model import GraphCast, MeshEdgeBlock, MeshNodeBlock
+from dgraph_tpu.models.graphcast.model import (
+    GraphCast,
+    MeshEdgeBlock,
+    MeshNodeBlock,
+    rollout,
+)
 
 __all__ = [
     "MultiMesh",
@@ -11,4 +16,5 @@ __all__ = [
     "GraphCast",
     "MeshEdgeBlock",
     "MeshNodeBlock",
+    "rollout",
 ]

@@ -153,3 +153,24 @@ class GraphCast(nn.Module):
         # --- prediction head: residual over input channels (model.py:392-394) ---
         delta = MLP([L, self.out_channels], dtype=self.dtype, name="head")(g)
         return grid_feats[..., : self.out_channels] + delta.astype(jnp.float32)
+
+
+def rollout(model: GraphCast, params, x0, statics, plans, num_steps: int):
+    """Autoregressive multi-step forecast: ``x_{t+1} = model(x_t)``.
+
+    The model's output IS the next full state (residual head over the
+    input channels), so chaining requires ``out_channels`` == the input
+    channel count. One ``lax.scan`` — the whole rollout is a single
+    compiled program (GraphCast's eval protocol; the reference repo
+    trains one-step only and has no rollout driver).
+
+    Returns [num_steps, n_grid_pad, C]: the predicted trajectory
+    x_1 .. x_{num_steps} (x0 excluded).
+    """
+
+    def step(x, _):
+        nxt = model.apply(params, x, statics, plans)
+        return nxt, nxt
+
+    _, traj = jax.lax.scan(step, x0, None, length=num_steps)
+    return traj

@@ -32,6 +32,10 @@ class Config:
     # GraphCast evaluates with Polyak-averaged weights (train/ema.py);
     # 0 disables the EMA track entirely
     ema_decay: float = 0.999
+    # >0: after training, run an autoregressive rollout of this many steps
+    # against the dataset's true trajectory (raw AND ema weights) and log
+    # per-step RMSE — GraphCast's eval protocol (models.graphcast.rollout)
+    eval_rollout: int = 0
     log_path: str = "logs/graphcast.jsonl"
     # elastic knobs (train/elastic.py): SIGTERM/SIGINT triggers a final
     # checkpoint + clean exit; a >0 deadline arms the per-step wedge
@@ -220,6 +224,40 @@ def main(cfg: Config):
         if dog is not None:
             dog.stop()
         guard.uninstall()
+
+    # a preemption asked for a prompt exit — the final checkpoint is saved;
+    # don't spend the grace period compiling a multi-minute rollout
+    if cfg.eval_rollout > 0 and not guard.should_stop():
+        from dgraph_tpu.models.graphcast import rollout as gc_rollout
+
+        x0, truth = ds.trajectory_sharded(0, cfg.eval_rollout)
+
+        def eval_body(p, x0_, statics_, plans_):
+            st = {k: v[0] for k, v in statics_.items()}
+            pln = {k: squeeze_plan(pp) for k, pp in plans_.items()}
+            traj = gc_rollout(model, p, x0_[0], st, pln, cfg.eval_rollout)
+            return traj[:, None]  # add the shard axis back: [T, 1, n, C]
+
+        run_rollout = jax.jit(jax.shard_map(
+            eval_body, mesh=mesh,
+            in_specs=(P(), P(GRAPH_AXIS), st_specs, pl_specs),
+            out_specs=P(None, GRAPH_AXIS),
+        ))
+        import numpy as np
+
+        m_np = np.asarray(gmask)[None, :, :, None]  # [1, W, n, 1]
+        denom = m_np.sum() * cfg.channels
+        tracks = [("raw", params)] + ([("ema", ema)] if ema is not None else [])
+        with jax.set_mesh(mesh):
+            for label, p in tracks:
+                traj = np.asarray(run_rollout(p, jnp.asarray(x0), statics, plans))
+                rmse = np.sqrt(
+                    ((traj - truth) ** 2 * m_np).sum(axis=(1, 2, 3)) / denom
+                )
+                log.write({
+                    "rollout_eval": label, "steps": cfg.eval_rollout,
+                    "rmse_per_step": [round(float(r), 5) for r in rmse],
+                })
     log.write({"timing": __import__("dgraph_tpu.utils", fromlist=["TimingReport"]).TimingReport.report()})
 
 

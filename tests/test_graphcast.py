@@ -224,3 +224,31 @@ def test_graphcast_trains(mesh8, graphs8):
             params, opt_state, loss = step(params, opt_state)
             losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_rollout_chains_single_steps(graphs1):
+    """rollout's scan must equal literally chaining model.apply — and its
+    first step must be exactly one forward pass."""
+    from dgraph_tpu.data.weather import SyntheticWeatherDataset
+    from dgraph_tpu.models.graphcast import rollout
+
+    comm = Communicator.init_process_group("single")
+    model = GraphCast(comm=comm, latent=16, processor_layers=1, out_channels=CH)
+    ds = SyntheticWeatherDataset(graphs1, NLAT, NLON, CH, num_samples=1)
+    x0, truth = ds.trajectory_sharded(0, 3)
+    assert truth.shape[0] == 3
+
+    sel0 = lambda a: jnp.asarray(a[0])
+    statics = statics_of(graphs1, sel0)
+    plans = plans_of(graphs1, sel0)
+    x0 = sel0(x0)
+    params = model.init(jax.random.key(0), x0, statics, plans)
+
+    traj = rollout(model, params, x0, statics, plans, 3)
+    assert traj.shape == (3,) + x0.shape[:1] + (CH,)
+    step1 = model.apply(params, x0, statics, plans)
+    np.testing.assert_allclose(np.asarray(traj[0]), np.asarray(step1),
+                               rtol=1e-5, atol=1e-5)
+    step2 = model.apply(params, step1, statics, plans)
+    np.testing.assert_allclose(np.asarray(traj[1]), np.asarray(step2),
+                               rtol=1e-5, atol=1e-5)
