@@ -29,7 +29,12 @@ Every strategy in SURVEY §2.3 (plus four the reference lacks) is therefore
 implemented and tested on the virtual 8-device mesh.
 """
 
-from dgraph_tpu.parallel.expert import load_balance_loss, moe_apply, top1_dispatch
+from dgraph_tpu.parallel.expert import (
+    load_balance_loss,
+    moe_apply,
+    top1_dispatch,
+    topk_dispatch,
+)
 from dgraph_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from dgraph_tpu.parallel.tensor import (
     column_parallel_dense,
@@ -70,6 +75,7 @@ __all__ = [
     "shard_rows",
     "moe_apply",
     "top1_dispatch",
+    "topk_dispatch",
     "load_balance_loss",
     "pipeline_apply",
     "stack_stage_params",

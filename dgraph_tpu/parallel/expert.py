@@ -1,5 +1,5 @@
-"""Expert parallelism: top-1 token-dispatch mixture-of-experts over a mesh
-axis.
+"""Expert parallelism: top-k token-dispatch mixture-of-experts over a mesh
+axis (k=1 switch routing and k>=2 GShard/Mixtral-style mixtures).
 
 Beyond-reference (SURVEY.md §2.3 lists expert parallelism as absent in the
 reference). One expert lives on each rank of an ``expert`` axis; a learned
@@ -11,10 +11,11 @@ layers is the exact analogue of this framework's ``s_pad`` halo padding.
 
 Dispatch math is all segment/one-hot primitives already used by the graph
 side: position-within-expert via a cumulative sum over the one-hot routing
-matrix, inverse routing by scatter into the dispatch slots' origin rows.
-Differentiable end to end (routing probabilities scale the expert outputs
-— the standard top-1 switch estimator; the all_to_all transposes are
-all_to_alls).
+matrix (choice-major, so 1st choices claim capacity first), inverse
+routing by scatter into the dispatch slots' origin rows. Differentiable
+end to end — the router learns through the gate product: raw softmax
+probability at k=1 (the switch estimator), renormalized top-k gates at
+k>1 (GShard/Mixtral); the all_to_all transposes are all_to_alls.
 """
 
 from __future__ import annotations
@@ -26,20 +27,31 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def top1_dispatch(
+def topk_dispatch(
     x: jax.Array,  # [T, F] this shard's tokens
     router_logits: jax.Array,  # [T, E] router scores (E = axis size)
     capacity: int,  # per-(src shard -> expert) slot budget (static)
     axis_name: str,
+    *,
+    k: int = 2,
+    normalize_gates: bool = True,
 ):
-    """Route each token to its argmax expert; returns everything the
+    """Route each token to its top-k experts; returns everything the
     combine step needs.
 
-    Returns (expert_in, combine): ``expert_in`` [W*capacity, F] — the
-    tokens THIS rank's expert must process (from every peer, peer p's
-    block at rows [p*capacity, (p+1)*capacity)); ``combine(expert_out)``
-    scatters processed rows back to their origin tokens, scaled by the
-    router probability (zeros for dropped/overflow tokens).
+    Slot assignment is CHOICE-MAJOR: every token's 1st choice claims
+    capacity before any 2nd choice does (the GShard priority rule), so
+    under pressure the layer degrades toward top-1 rather than dropping
+    primary routes. ``normalize_gates=True`` renormalizes the selected
+    gates to sum to 1 per token (the GShard/Mixtral convention);
+    ``False`` keeps raw softmax probabilities (the top-1 switch
+    estimator uses this).
+
+    Returns (expert_in, combine): ``expert_in`` [E*capacity, F] — the
+    tokens THIS rank's expert must process (peer p's block at rows
+    [p*capacity, (p+1)*capacity)); ``combine(expert_out)`` returns each
+    token's gate-weighted SUM over its k expert outputs (zeros for
+    dropped/overflow routes).
     """
     T, F = x.shape
     E = lax.psum(1, axis_name)
@@ -48,20 +60,29 @@ def top1_dispatch(
             f"router width {router_logits.shape[-1]} != expert-axis size "
             f"{E}: out-of-range expert ids would be silently dropped"
         )
+    if not 1 <= k <= E:
+        raise ValueError(f"top-k k={k} must be in [1, {E}]")
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)  # [T]
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]  # [T]
+    gates_k, experts_k = lax.top_k(probs, k)  # [T, k] each
+    if normalize_gates:
+        gates_k = gates_k / jnp.maximum(
+            gates_k.sum(axis=-1, keepdims=True), 1e-20)
 
-    # position of each token within its expert's send block (one-hot cumsum
-    # — same trick as the plan builder's slot numbering, done in-jit)
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [T, E]
-    pos = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(T), expert]  # [T]
-    keep = pos < capacity  # overflow tokens are dropped (capacity factor)
+    # flatten routes CHOICE-major: row c*T + t = token t's c-th choice
+    ec = experts_k.T.reshape(k * T)  # [k*T]
+    gc = gates_k.T.reshape(k * T)
+    # position of each route within its expert's send block (one-hot
+    # cumsum — the plan builder's slot numbering, done in-jit)
+    onehot = jax.nn.one_hot(ec, E, dtype=jnp.int32)  # [k*T, E]
+    pos = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(k * T), ec]
+    keep = pos < capacity  # overflow routes are dropped (capacity factor)
 
-    # build the per-expert send buffer [E, capacity, F]
-    slot = jnp.where(keep, expert * capacity + pos, E * capacity)
+    # build the per-expert send buffer [E, capacity, F]; distinct routes
+    # always land in distinct slots, so the scatter has no conflicts
+    slot = jnp.where(keep, ec * capacity + pos, E * capacity)
+    x_rep = jnp.tile(x, (k, 1))  # choice-major replication
     send = jnp.zeros((E * capacity, F), x.dtype).at[slot].set(
-        x, mode="drop"
+        x_rep, mode="drop"
     ).reshape(E, capacity, F)
     # tokens land on their expert's rank, peer blocks in rank order — the
     # halo-exchange landing discipline
@@ -69,18 +90,32 @@ def top1_dispatch(
         send, axis_name, split_axis=0, concat_axis=0
     ).reshape(E * capacity, F)
 
-    def combine(expert_out: jax.Array) -> jax.Array:  # [W*capacity, F']
+    def combine(expert_out: jax.Array) -> jax.Array:  # [E*capacity, F']
         back = lax.all_to_all(
             expert_out.reshape(E, capacity, -1), axis_name,
             split_axis=0, concat_axis=0,
         ).reshape(E * capacity, -1)
         rows = jnp.take(back, jnp.minimum(slot, E * capacity - 1), axis=0)
         rows = jnp.where(keep[:, None], rows, 0.0)
-        # scale by the router prob: the top-1 switch gradient estimator —
-        # the router learns through this product
-        return rows * gate[:, None].astype(rows.dtype)
+        # scale by the router gate: the router learns through this
+        # product (switch estimator at k=1; weighted mixture at k>1)
+        rows = rows * gc[:, None].astype(rows.dtype)
+        return rows.reshape(k, T, -1).sum(axis=0)
 
     return expert_in, combine
+
+
+def top1_dispatch(
+    x: jax.Array,
+    router_logits: jax.Array,
+    capacity: int,
+    axis_name: str,
+):
+    """Top-1 switch routing = :func:`topk_dispatch` with k=1 and RAW
+    softmax gates (the switch gradient estimator)."""
+    return topk_dispatch(
+        x, router_logits, capacity, axis_name, k=1, normalize_gates=False
+    )
 
 
 def moe_apply(
@@ -90,14 +125,25 @@ def moe_apply(
     expert_params,
     capacity: int,
     axis_name: str,
+    *,
+    k: int = 1,
+    normalize_gates: bool | None = None,
 ) -> jax.Array:
-    """Full top-1 MoE layer: dispatch -> local expert -> combine.
+    """Full MoE layer: dispatch -> local expert -> combine.
 
-    ONE ``all_to_all`` each way — two per layer, the textbook MoE cost;
-    overflow beyond ``capacity`` per (shard, expert) pair contributes zeros (route
-    a residual around the layer upstream, as switch transformers do).
+    ONE ``all_to_all`` each way — two per layer regardless of k (the
+    routes multiplex into the same padded buffers); overflow beyond
+    ``capacity`` per (shard, expert) pair contributes zeros (route a
+    residual around the layer upstream, as switch transformers do).
+    k=1 keeps the raw-probability switch estimator; k>1 defaults to
+    gate renormalization (GShard/Mixtral) unless overridden.
     """
-    expert_in, combine = top1_dispatch(x, router_logits, capacity, axis_name)
+    if normalize_gates is None:
+        normalize_gates = k > 1
+    expert_in, combine = topk_dispatch(
+        x, router_logits, capacity, axis_name, k=k,
+        normalize_gates=normalize_gates,
+    )
     return combine(expert_fn(expert_params, expert_in))
 
 

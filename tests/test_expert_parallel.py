@@ -119,3 +119,139 @@ def test_load_balance_loss_range():
     val = float(fn(logits))
     # perfectly balanced -> 1.0; collapsed -> E. Random logits near 1.
     assert 0.9 < val < E
+
+
+def _dense_topk_oracle(x, logits, params_list, k):
+    """Ample-capacity dense oracle for top-k: per token, the gate-weighted
+    sum of its top-k experts' outputs with gates renormalized over k."""
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    order = np.argsort(-probs, axis=-1)[:, :k]  # [T, k]
+    out = np.zeros_like(np.asarray(x))
+    for t in range(x.shape[0]):
+        sel = order[t]
+        g = probs[t, sel]
+        g = g / g.sum()
+        for c, e in enumerate(sel):
+            p = params_list[e]
+            out[t] += g[c] * np.asarray(
+                _expert_fn({k2: jnp.asarray(v) for k2, v in p.items()},
+                           jnp.asarray(x[t][None]))
+            )[0]
+    return out
+
+
+def test_top2_matches_dense_oracle():
+    mesh = _mesh()
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((E, T, F)).astype(np.float32)
+    logits = rng.standard_normal((E, T, E)).astype(np.float32)
+    params_list = _params(rng)
+    stacked = jax.tree.map(
+        lambda *xs: jnp.asarray(np.stack(xs)), *params_list
+    )
+    CAP = E * T  # ample: nothing drops
+
+    def body(x_, lg, ep):
+        return moe_apply(
+            x_, lg, _expert_fn, jax.tree.map(lambda l: l[0], ep), CAP,
+            "expert", k=2,
+        )
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P("expert"), P("expert"), P("expert")),
+        out_specs=P("expert"),
+        check_vma=False,
+    )
+    with jax.set_mesh(mesh):
+        got = np.asarray(fn(
+            jnp.asarray(x.reshape(E * T, F)),
+            jnp.asarray(logits.reshape(E * T, E)), stacked,
+        ))
+    for s in range(E):  # every shard against the dense oracle
+        want = _dense_topk_oracle(x[s], logits[s], params_list, k=2)
+        np.testing.assert_allclose(
+            got[s * T:(s + 1) * T], want, rtol=2e-5, atol=2e-5,
+            err_msg=f"shard {s}",
+        )
+
+
+def test_top2_choice_major_priority_under_pressure():
+    """First choices must claim capacity before ANY second choice (the
+    GShard priority rule). Routes genuinely compete: ODD tokens' 1st
+    choice is expert 0, EVEN tokens' 2nd choice is also expert 0 (and
+    symmetrically for expert 1), with capacity = half the per-expert
+    demand. Choice-major assignment keeps exactly every 1st-choice route
+    and drops every 2nd-choice route; token-major assignment would let
+    early even tokens' 2nd choices steal expert-0 slots from late odd
+    tokens' 1st choices — a different, detectably wrong output."""
+    mesh = _mesh()
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((E * T, F)).astype(np.float32)
+    logits = np.zeros((E * T, E), np.float32)
+    odd = (np.arange(E * T) % 2).astype(bool)
+    logits[odd, 0], logits[odd, 1] = 4.0, 2.0   # odd: 1st->e0, 2nd->e1
+    logits[~odd, 1], logits[~odd, 0] = 4.0, 2.0  # even: 1st->e1, 2nd->e0
+    cap = T // 2  # = the number of 1st-choice routes per (shard, expert)
+
+    params_list = _params(rng)
+    stacked = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)), *params_list)
+
+    def body(x_, lg, ep):
+        return moe_apply(
+            x_, lg, _expert_fn, jax.tree.map(lambda l: l[0], ep), cap,
+            "expert", k=2,
+        )
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P("expert"), P("expert"), P("expert")),
+        out_specs=P("expert"),
+        check_vma=False,
+    )
+    with jax.set_mesh(mesh):
+        got = np.asarray(fn(jnp.asarray(x), jnp.asarray(logits), stacked))
+
+    # oracle: every token keeps ONLY its 1st choice (x its renormalized
+    # 1st gate); every 2nd-choice route drops
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    g1 = np.take_along_axis(probs, np.argmax(probs, -1)[:, None], 1)[:, 0]
+    g2 = np.partition(probs, -2, axis=-1)[:, -2]
+    w1 = g1 / (g1 + g2)
+    first = np.where(odd, 0, 1)
+    want = np.zeros_like(got)
+    for e in (0, 1):
+        sel = first == e
+        p = {k2: jnp.asarray(v) for k2, v in params_list[e].items()}
+        want[sel] = w1[sel, None] * np.asarray(
+            _expert_fn(p, jnp.asarray(x[sel])))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_top2_router_gradients_flow():
+    mesh = _mesh()
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((E * T, F)), jnp.float32)
+    logits = jnp.asarray(rng.standard_normal((E * T, E)), jnp.float32)
+    params_list = _params(rng)
+    stacked = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)), *params_list)
+
+    def loss(lg):
+        def body(x_, lg_, ep):
+            out = moe_apply(
+                x_, lg_, _expert_fn, jax.tree.map(lambda l: l[0], ep),
+                2 * T, "expert", k=2,
+            )
+            return out
+
+        fn = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P("expert"), P("expert"), P("expert")),
+            out_specs=P("expert"),
+            check_vma=False,
+        )
+        return (fn(x, lg, stacked) ** 2).sum()
+
+    with jax.set_mesh(mesh):
+        g = jax.grad(loss)(logits)
+    assert float(jnp.abs(g).sum()) > 0  # the router learns through gates
