@@ -204,6 +204,50 @@ def pallas_fused_selfcheck() -> bool:
     return ok
 
 
+def pallas_gather_selfcheck() -> bool:
+    """Chip gate for the sorted ROW-GATHER kernel. Only consulted when the
+    env pins DGRAPH_TPU_PALLAS_GATHER=1 (the kernel is explicit-opt-in
+    until on-chip A/B data exists); the check still has the final veto."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return False
+    from dgraph_tpu.ops.pallas_segment import (
+        max_chunks_hint,
+        max_vblocks_hint,
+        sorted_row_gather,
+    )
+
+    from dgraph_tpu.plan import SCATTER_BLOCK_E, SCATTER_BLOCK_N
+
+    rng = np.random.default_rng(13)
+    E, N, F = 8192, 2048, 128
+    ids = np.sort(rng.integers(0, N, E)).astype(np.int32)
+    ids[-64:] = N + 1
+    x = rng.standard_normal((N, F)).astype(np.float32)
+    want = np.where((ids < N)[:, None], x[np.clip(ids, 0, N - 1)], 0.0)
+    ok = True
+    # the exact tile configs the plans emit, plus the library default
+    # (Mosaic bugs can be tile-size-dependent — same invariant as
+    # pallas_selfcheck)
+    for be, bn in sorted({(512, 256), (SCATTER_BLOCK_E, SCATTER_BLOCK_N)}):
+        mv = max_vblocks_hint(ids, N, block_e=be, block_n=bn)
+        mc = max_chunks_hint(ids, N, block_e=be, block_n=bn)
+        for dt, prec, tol in _selfcheck_cases():
+            ok &= _check_one(
+                f"sorted-gather(be={be},bn={bn},{dt.__name__})",
+                lambda dt=dt, prec=prec, be=be, bn=bn, mv=mv, mc=mc:
+                sorted_row_gather(
+                    jnp.asarray(x, dt), jnp.asarray(ids), max_vblocks=mv,
+                    block_e=be, block_n=bn, scatter_mc=mc, precision=prec,
+                ).astype(jnp.float32),
+                want, tol,
+            )
+    return ok
+
+
 def bench_gcn(dtype_name: str):
     import functools
 
@@ -575,6 +619,10 @@ def _child_main():
     else:  # auto: follow the plain-scatter decision
         fused_wanted = cfg.use_pallas_scatter
     cfg.set_flags(use_pallas_fused=fused_wanted and pallas_fused_selfcheck())
+    # sorted row-gather kernel: explicit opt-in only (no auto state yet —
+    # see config.use_pallas_gather); the chip self-check has the veto
+    if cfg.use_pallas_gather is True:
+        cfg.set_flags(use_pallas_gather=pallas_gather_selfcheck())
 
     try:
         dt_ms, roof = bench_gcn(dtype_name)

@@ -223,7 +223,8 @@ def local_take(full: jax.Array, plan: EdgePlan, side: str) -> jax.Array:
         else None
     )
     taken = local_ops.take_rows(
-        full, idx, indices_are_sorted=sorted_ids, pallas_hints=hints
+        full, idx, indices_are_sorted=sorted_ids, pallas_hints=hints,
+        gather_mv=plan.gather_mv,
     )
     return taken * plan.edge_mask[:, None].astype(full.dtype)
 
@@ -276,7 +277,7 @@ def scatter_sum(
         if plan.ids_sorted(side):
             return local_ops.sorted_segment_sum_any(
                 edata, idx, n_pad, plan.scatter_block_e, plan.scatter_block_n,
-                plan.scatter_mc,
+                plan.scatter_mc, gather_mv=plan.gather_mv,
             )
         return local_ops.segment_sum(edata, idx, n_pad, indices_are_sorted=False)
     W = plan.world_size
@@ -328,7 +329,7 @@ def scatter_bias_relu(
         return local_ops.sorted_segment_sum_bias_relu_any(
             edata, idx, bias, n_pad,
             plan.scatter_block_e, plan.scatter_block_n, plan.scatter_mc,
-            edge_weight=edge_weight,
+            edge_weight=edge_weight, gather_mv=plan.gather_mv,
         )
     m = jax.nn.relu(edata + gather(bias, plan, side, axis_name))
     if edge_weight is not None:

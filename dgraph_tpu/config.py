@@ -33,6 +33,19 @@ def pallas_scatter_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
+# The Pallas sorted ROW-GATHER kernel (transpose of the scatter;
+# ops.pallas_segment.sorted_row_gather). Tri-state, but unlike the
+# scatter its AUTO state is OFF: it has never been A/B'd on a real chip
+# (r2's XLA-gather numbers were invalidated by the timing-harness fix),
+# so it engages only on an explicit DGRAPH_TPU_PALLAS_GATHER=1 (or
+# set_flags) until on-chip data says otherwise.
+use_pallas_gather: bool | None = _env_flag("DGRAPH_TPU_PALLAS_GATHER", None)
+
+
+def pallas_gather_enabled() -> bool:
+    return use_pallas_gather is True
+
+
 # The FUSED bias+relu scatter kernel gets its own kill switch (tri-state;
 # None = follow the plain-scatter decision): a Mosaic regression in one
 # kernel must be disablable without losing the other (bench's self-check

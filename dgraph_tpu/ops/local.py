@@ -71,7 +71,8 @@ def row_take(
 
 
 @functools.lru_cache(maxsize=None)
-def _make_take_rows(n_rows, sorted_ids, col_block, pallas, block_e, block_n, mc):
+def _make_take_rows(n_rows, sorted_ids, col_block, pallas, block_e, block_n,
+                    mc, gather_mv=0):
     """Row gather whose VJP is an explicitly-routed segment reduction.
 
     JAX's default transpose of ``x[idx]`` is a generic XLA scatter-add —
@@ -83,7 +84,25 @@ def _make_take_rows(n_rows, sorted_ids, col_block, pallas, block_e, block_n, mc)
     ``_torch_func_impl.py:112-191``):
       - sorted ids + Pallas available -> one-hot MXU sorted_segment_sum
       - otherwise -> jax.ops.segment_sum (with the sortedness hint)
+
+    The FORWARD can additionally run as the Pallas sorted-row-gather
+    kernel when ``gather_mv > 0`` (the caller resolves
+    ``config.use_pallas_gather`` — explicit opt-in until on-chip A/B data
+    exists — BEFORE this lru-cached factory, so the flag is part of the
+    cache key); it defines its own exact-transpose VJP, so the custom-VJP
+    wrapper below is bypassed entirely in that case.
     """
+    if pallas and gather_mv > 0:
+        from dgraph_tpu.ops.pallas_segment import sorted_row_gather
+
+        def take_kernel(x, idx):
+            prec = "default" if x.dtype == jnp.bfloat16 else "highest"
+            return sorted_row_gather(
+                x, idx, max_vblocks=gather_mv, block_e=block_e,
+                block_n=block_n, scatter_mc=mc, precision=prec,
+            )
+
+        return take_kernel
 
     @jax.custom_vjp
     def take(x, idx):
@@ -118,14 +137,17 @@ def take_rows(
     indices_are_sorted: bool = False,
     col_block: int | None = None,
     pallas_hints: tuple | None = None,  # (block_e, block_n, max_chunks) or None
+    gather_mv: int = 0,  # >0 + config.use_pallas_gather: Pallas fwd kernel
 ) -> jax.Array:
     """``x[idx]`` row gather with a fast-path VJP (see
     :func:`_make_take_rows`). Out-of-range ids produce zero rows (padding
     convention). ``pallas_hints`` enables the sorted one-hot MXU kernel for
-    the backward when ids are monotone (plan-guaranteed)."""
-    if col_block is None:
-        from dgraph_tpu import config as _cfg
+    the backward when ids are monotone (plan-guaranteed); ``gather_mv``
+    additionally enables the sorted-row-gather FORWARD kernel when
+    ``config.use_pallas_gather`` is pinned on."""
+    from dgraph_tpu import config as _cfg
 
+    if col_block is None:
         col_block = _cfg.gather_col_block
     use_pallas = (
         pallas_hints is not None
@@ -133,12 +155,13 @@ def take_rows(
         and jax.default_backend() == "tpu"
     )
     be, bn, mc = pallas_hints if use_pallas else (0, 0, 0)
+    mv = gather_mv if (use_pallas and _cfg.pallas_gather_enabled()) else 0
     return _make_take_rows(
-        x.shape[0], indices_are_sorted, col_block, use_pallas, be, bn, mc
+        x.shape[0], indices_are_sorted, col_block, use_pallas, be, bn, mc, mv
     )(x, idx)
 
 
-def sorted_segment_sum_any(data, sorted_ids, n_rows, be, bn, mc):
+def sorted_segment_sum_any(data, sorted_ids, n_rows, be, bn, mc, gather_mv=0):
     """Sorted segment-sum via the Pallas MXU kernel when it's enabled AND
     the backend is TPU, jnp elsewhere. The single dispatch point for every
     sorted reduction (owner-side scatter and the halo sort route) so the
@@ -153,7 +176,7 @@ def sorted_segment_sum_any(data, sorted_ids, n_rows, be, bn, mc):
         prec = "default" if data.dtype == jnp.bfloat16 else "highest"
         return sorted_segment_sum(
             data, sorted_ids, n_rows, max_chunks_per_block=mc,
-            block_e=be, block_n=bn, precision=prec,
+            block_e=be, block_n=bn, gather_mv=gather_mv, precision=prec,
         )
     # fallback keeps the col-split-take VJP pinning (segment_sum wrapper),
     # not jax.ops.segment_sum's plain wide-gather transpose
@@ -162,6 +185,7 @@ def sorted_segment_sum_any(data, sorted_ids, n_rows, be, bn, mc):
 
 def sorted_segment_sum_bias_relu_any(
     edata, sorted_ids, bias, n_rows, be, bn, mc, edge_weight=None,
+    gather_mv=0,
 ):
     """Fused Σ w·relu(edata + bias[id]) for sorted ids — Pallas on TPU
     (``ops.pallas_segment.sorted_segment_sum_bias_relu``), composed jnp ops
@@ -176,7 +200,8 @@ def sorted_segment_sum_bias_relu_any(
         prec = "default" if edata.dtype == jnp.bfloat16 else "highest"
         return sorted_segment_sum_bias_relu(
             edata, sorted_ids, bias, n_rows, edge_weight=edge_weight,
-            max_chunks_per_block=mc, block_e=be, block_n=bn, precision=prec,
+            max_chunks_per_block=mc, block_e=be, block_n=bn,
+            gather_mv=gather_mv, precision=prec,
         )
     m = jax.nn.relu(edata + row_take(bias, sorted_ids, oob="fill"))
     if edge_weight is not None:

@@ -190,7 +190,7 @@ def _sorted_segment_sum_impl(
 
 @functools.lru_cache(maxsize=None)
 def _make_sss(num_segments, max_chunks_per_block, block_e, block_n, interpret,
-              input_op, precision):
+              input_op, precision, gather_mv=0):
     impl = functools.partial(
         _sorted_segment_sum_impl,
         num_segments=num_segments, max_chunks_per_block=max_chunks_per_block,
@@ -208,12 +208,12 @@ def _make_sss(num_segments, max_chunks_per_block, block_e, block_n, interpret,
 
     def bwd(res, g):
         segment_ids, data = res
-        # column-chunked take: the same >128-lane row-gather cliff the
-        # forward path avoids applies to the grad gather (shared impl:
-        # ops.local.row_take, OOB ids -> zero grad rows)
-        from dgraph_tpu.ops.local import row_take
-
-        gd = row_take(g, segment_ids, oob="fill")
+        # grad gather of the cotangent rows: sorted-row-gather kernel
+        # when pinned on (config read at trace time; bench sets flags
+        # before compiling), else the column-chunked take (the >128-lane
+        # row-gather cliff applies to the grad gather too)
+        gd = _take_sorted(g, segment_ids, gather_mv,
+                          block_e, block_n, max_chunks_per_block)
         if input_op == "relu":
             gd = gd * (data > 0).astype(gd.dtype)
         return gd, None
@@ -232,6 +232,8 @@ def sorted_segment_sum(
     block_n: int = 256,
     interpret: bool = False,
     input_op: str = "none",  # "none" | "relu" (fused input epilogue)
+    gather_mv: int = 0,  # >0: the VJP's cotangent-row gather may use the
+    # sorted-row-gather kernel (explicit config opt-in; plan.gather_mv)
     precision: str = "highest",  # MXU passes for the one-hot contraction:
     # "highest" = f32-faithful accumulation (matches the CUDA atomicAdd
     # semantics, ~1.4x XLA's scatter path on v5e); "default" = bf16 input
@@ -250,7 +252,7 @@ def sorted_segment_sum(
     """
     return _make_sss(
         num_segments, max_chunks_per_block, block_e, block_n, interpret,
-        input_op, precision,
+        input_op, precision, gather_mv,
     )(data, segment_ids)
 
 
@@ -311,9 +313,25 @@ def _kernel_bias_relu(
         )
 
 
+def _take_sorted(g, ids, gather_mv, block_e, block_n, mc):
+    """Bwd-side row take by PLAN-SORTED ids: the Pallas sorted-row-gather
+    kernel when the explicit opt-in flag is pinned and the plan carried a
+    span hint, ops.local.row_take otherwise (OOB ids -> zero rows)."""
+    from dgraph_tpu import config as _cfg
+    from dgraph_tpu.ops.local import row_take
+
+    if gather_mv > 0 and _cfg.pallas_gather_enabled():
+        prec = "default" if g.dtype == jnp.bfloat16 else "highest"
+        return sorted_row_gather(
+            g, ids, max_vblocks=gather_mv, block_e=block_e, block_n=block_n,
+            scatter_mc=mc, precision=prec,
+        )
+    return row_take(g, ids, oob="fill")
+
+
 @functools.lru_cache(maxsize=None)
 def _make_ssbr(num_segments, max_chunks_per_block, block_e, block_n, interpret,
-               precision, has_weight):
+               precision, has_weight, gather_mv=0):
     def impl(data, segment_ids, bias, edge_weight):
         E, F = data.shape
         sched = _ChunkSchedule(
@@ -364,15 +382,19 @@ def _make_ssbr(num_segments, max_chunks_per_block, block_e, block_n, interpret,
 
     def bwd(res, g):
         data, segment_ids, bias, edge_weight = res
-        from dgraph_tpu.ops.local import row_take
 
         # recompute the activation mask (remat: the [E,F] pre-activation
-        # was never materialized in the forward — that's the point)
-        pre = data.astype(jnp.float32) + row_take(
-            bias.astype(jnp.float32), segment_ids, oob="fill"
+        # was never materialized in the forward — that's the point); both
+        # row takes are by the plan's sorted ids -> kernel-upgradeable
+        pre = data.astype(jnp.float32) + _take_sorted(
+            bias.astype(jnp.float32), segment_ids, gather_mv,
+            block_e, block_n, max_chunks_per_block,
         )
         act = (pre > 0).astype(jnp.float32)
-        g_rows = row_take(g.astype(jnp.float32), segment_ids, oob="fill")
+        g_rows = _take_sorted(
+            g.astype(jnp.float32), segment_ids, gather_mv,
+            block_e, block_n, max_chunks_per_block,
+        )
         w = edge_weight[:, None].astype(jnp.float32) if has_weight else 1.0
         gd = g_rows * act * w  # d/d(data)
         # d/d(bias[v]) = g[v] * sum_e w_e*act_e  (sorted ids -> fast path)
@@ -405,6 +427,7 @@ def sorted_segment_sum_bias_relu(
     block_e: int = 512,
     block_n: int = 256,
     interpret: bool = False,
+    gather_mv: int = 0,  # see sorted_segment_sum
     precision: str = "default",
 ) -> jax.Array:
     """out[v] = Σ_{e: ids[e]=v} w[e] * relu(data[e] + bias[v]) without ever
@@ -413,7 +436,7 @@ def sorted_segment_sum_bias_relu(
     has_w = edge_weight is not None
     fn = _make_ssbr(
         num_segments, max_chunks_per_block, block_e, block_n, interpret,
-        precision, has_w,
+        precision, has_w, gather_mv,
     )
     if not has_w:
         edge_weight = jnp.zeros((data.shape[0],), data.dtype)
@@ -433,3 +456,167 @@ def max_chunks_hint(
     cs = starts // block_e
     ce = -(-ends // block_e)
     return max(1, int((ce - cs).max(initial=1)))
+
+
+# --- sorted row gather: the transpose kernel -------------------------------
+
+
+def _gather_kernel(
+    vb_starts_ref, vb_counts_ref, ids_ref, x_ref, out_ref, *,
+    block_n, block_e, precision,
+):
+    k = pl.program_id(0)  # edge chunk (owns the resident out block)
+    j = pl.program_id(1)  # vertex-block iteration within the chunk's span
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(j < vb_counts_ref[k])
+    def _accumulate():
+        ids = ids_ref[0, 0]  # [block_e] int32 (global, sorted)
+        vb = vb_starts_ref[k] + j  # this iteration's vertex block
+        rel2 = (ids - vb * block_n)[:, None]  # [block_e, 1] (2-D: Mosaic)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_e, block_n), 1)
+        onehot = jnp.where(
+            (cols == rel2) & (rel2 >= 0) & (rel2 < block_n), 1.0, 0.0
+        ).astype(x_ref.dtype)
+        # [block_e, block_n] @ [block_n, F] -> rows selected on the MXU;
+        # OOB/masked ids match no column and stay zero
+        out_ref[...] += jax.lax.dot_general(
+            onehot, x_ref[...],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=out_ref.dtype,
+            precision=precision,
+        )
+
+
+@functools.lru_cache(maxsize=None)
+def _make_srg(num_rows, max_vblocks, block_e, block_n, interpret, precision,
+              scatter_mc):
+    def impl(x, ids):
+        E = ids.shape[0]
+        F = x.shape[1]
+        E_pad = pl.cdiv(E, block_e) * block_e
+        N_pad = pl.cdiv(num_rows, block_n) * block_n
+        nb = N_pad // block_n
+        num_chunks = E_pad // block_e
+        ids_p = ids
+        if E_pad != E:
+            ids_p = jnp.pad(ids, (0, E_pad - E), constant_values=num_rows + 1)
+        x_p = x
+        if N_pad != x.shape[0]:
+            x_p = jnp.pad(x, ((0, N_pad - x.shape[0]), (0, 0)))
+        ids3d = ids_p.reshape(num_chunks, 1, block_e)
+        # per-chunk vertex-block span (ids sorted within each chunk):
+        # first/last element of the chunk, clamped into [0, nb)
+        firsts = jnp.clip(ids_p.reshape(num_chunks, block_e)[:, 0], 0,
+                          N_pad - 1)
+        lasts = jnp.clip(ids_p.reshape(num_chunks, block_e)[:, -1], 0,
+                         N_pad - 1)
+        vb_start = (firsts // block_n).astype(jnp.int32)
+        vb_counts = jnp.minimum(
+            (lasts // block_n).astype(jnp.int32) - vb_start + 1, max_vblocks
+        ).astype(jnp.int32)
+
+        def ids_index(k, j, starts, counts):
+            return (k, 0, 0)
+
+        def x_index(k, j, starts, counts):
+            # clamp past-count iterations onto the last valid block: Mosaic
+            # skips the DMA when consecutive steps map to the same block
+            return (
+                jnp.minimum(
+                    starts[k] + jnp.minimum(j, jnp.maximum(counts[k] - 1, 0)),
+                    nb - 1,
+                ),
+                0,
+            )
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(num_chunks, max_vblocks),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_e), ids_index),
+                pl.BlockSpec((block_n, F), x_index),
+            ],
+            out_specs=pl.BlockSpec((block_e, F), lambda k, j, s, c: (k, 0)),
+        )
+        out = pl.pallas_call(
+            functools.partial(
+                _gather_kernel, block_n=block_n, block_e=block_e,
+                precision=_precision(precision),
+            ),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((E_pad, F), jnp.float32),
+            interpret=interpret,
+        )(vb_start, vb_counts, ids3d, x_p)
+        return out[:E].astype(x.dtype)
+
+    @jax.custom_vjp
+    def f(x, ids):
+        return impl(x, ids)
+
+    def fwd(x, ids):
+        return impl(x, ids), ids
+
+    def bwd(ids, g):
+        # exact transpose: segment-sum of the cotangent rows back onto the
+        # gathered vertices — the EXISTING sorted scatter kernel
+        from dgraph_tpu.ops.local import sorted_segment_sum_any
+
+        dx = sorted_segment_sum_any(
+            g, ids, num_rows, block_e, block_n, scatter_mc
+        )
+        return dx, None
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def sorted_row_gather(
+    x: jax.Array,  # [N, F] vertex table
+    ids: jax.Array,  # [E] int32 MONOTONE non-decreasing row ids
+    *,
+    max_vblocks: int,  # >= max vertex blocks any edge chunk spans
+    block_e: int = 512,
+    block_n: int = 256,
+    scatter_mc: int = 1,  # max_chunks hint for the VJP's segment sum
+    interpret: bool = False,
+    precision: str = "highest",  # the op is a pure row COPY: f32 inputs
+    # must come back bit-faithful by default; callers in a bf16 compute
+    # path pass "default" explicitly (the shared dtype->precision policy)
+) -> jax.Array:
+    """``x[ids]`` for sorted ids as blocked one-hot MXU matmuls — the exact
+    TRANSPOSE of :func:`sorted_segment_sum` (same tiles, roles of the
+    resident/streamed operands swapped). Rows whose id falls outside
+    [0, N) come back zero (masked-edge convention). Differentiable: the
+    VJP is the sorted segment-sum kernel.
+
+    Compute ``max_vblocks`` at plan-build time with
+    :func:`max_vblocks_hint`; the schedule reads only each chunk's
+    first/last id (sortedness), so it is computed in-jit.
+    """
+    return _make_srg(
+        x.shape[0], max_vblocks, block_e, block_n, interpret, precision,
+        scatter_mc,
+    )(x, ids)
+
+
+def max_vblocks_hint(
+    segment_ids, num_rows: int, block_e: int = 512, block_n: int = 256
+) -> int:
+    """Host-side (concrete sorted ids) bound for
+    :func:`sorted_row_gather`'s ``max_vblocks``: the max number of
+    ``block_n``-row vertex blocks any ``block_e`` edge chunk spans."""
+    import numpy as np
+
+    ids = np.clip(np.asarray(segment_ids), 0, max(num_rows - 1, 0))
+    E = ids.shape[0]
+    if E == 0:
+        return 1
+    E_pad = -(-E // block_e) * block_e
+    ids_p = np.pad(ids, (0, E_pad - E), constant_values=ids[-1])
+    chunks = ids_p.reshape(-1, block_e)
+    span = chunks[:, -1] // block_n - chunks[:, 0] // block_n + 1
+    return max(1, int(span.max(initial=1)))

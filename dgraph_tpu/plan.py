@@ -294,6 +294,7 @@ class HaloSpec:
         "scatter_block_n",
         "halo_deltas",
         "halo_sort_mc",
+        "gather_mv",
     )
 )
 class EdgePlan:
@@ -361,6 +362,10 @@ class EdgePlan:
     halo_sort_perm: Any = None  # i32[W, E] or None
     halo_sorted_ids: Any = None  # i32[W, E] or None
     halo_sort_mc: int = 1  # static; max_chunks hint for the sorted route
+    # Pallas sorted-row-gather hint: max vertex blocks any scatter_block_e
+    # edge chunk spans (ops.pallas_segment.sorted_row_gather). 0 on plans
+    # predating the kernel (stale caches rebuild via PLAN_FORMAT_VERSION).
+    gather_mv: int = 0
 
     def ids_sorted(self, side: str) -> bool:
         """True iff this side's per-edge index is monotone: the OWNER side
@@ -766,7 +771,10 @@ def _finalize_plan(
     owner_idx_arr = dst_idx_arr if edge_owner == "dst" else src_idx_arr
     scatter_block_e, scatter_block_n = SCATTER_BLOCK_E, SCATTER_BLOCK_N
     if owner_sorted:
-        from dgraph_tpu.ops.pallas_segment import max_chunks_hint
+        from dgraph_tpu.ops.pallas_segment import (
+            max_chunks_hint,
+            max_vblocks_hint,
+        )
 
         scatter_mc = max(
             max_chunks_hint(
@@ -775,8 +783,16 @@ def _finalize_plan(
             )
             for r in range(W)
         )
+        gather_mv = max(
+            max_vblocks_hint(
+                owner_idx_arr[r], n_owner_pad,
+                block_e=scatter_block_e, block_n=scatter_block_n,
+            )
+            for r in range(W)
+        )
     else:
         scatter_mc = 1
+        gather_mv = 0
 
     # halo-side sorted route (see EdgePlan.halo_sort_perm)
     halo_sort_perm = halo_sorted_ids = None
@@ -822,6 +838,7 @@ def _finalize_plan(
         halo_sort_perm=halo_sort_perm,
         halo_sorted_ids=halo_sorted_ids,
         halo_sort_mc=halo_sort_mc,
+        gather_mv=gather_mv,
     )
     layout = EdgePlanLayout(
         edge_rank=edge_rank,

@@ -75,8 +75,9 @@ def restore_checkpoint(ckpt_dir: str, template: dict, step: Optional[int] = None
 # Bump whenever EdgePlan's fields/defaults change shape or meaning: stale
 # cache pickles must REBUILD, not silently inherit new class defaults for
 # fields they were never built with (e.g. scatter_block_e).
-PLAN_FORMAT_VERSION = 4  # v4: halo-side sorted route (halo_sort_perm /
-# halo_sorted_ids / halo_sort_mc); v3: scatter_block_e default 512 -> 1024
+PLAN_FORMAT_VERSION = 5  # v5: gather_mv (sorted-row-gather vblock hint);
+# v4: halo-side sorted route (halo_sort_perm / halo_sorted_ids /
+# halo_sort_mc); v3: scatter_block_e default 512 -> 1024
 
 
 def _hash_array(h, arr: np.ndarray) -> None:

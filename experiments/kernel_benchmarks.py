@@ -99,6 +99,28 @@ def main(cfg: Config):
         t = bench(lambda a: local_ops.row_take(a, idx, col_block=128), x)
         record(op="gather_col_split", F=F, dtype=dname, ms=round(t, 3),
                gbps=round(E_pad * F * b / t / 1e6, 1))
+        # sorted-id gathers: the owner-side case (XLA vs the Pallas
+        # transpose kernel — the A/B that decides use_pallas_gather)
+        t = bench(lambda a: local_ops.row_take(a, sids, col_block=128), x)
+        record(op="gather_sorted_xla", F=F, dtype=dname, ms=round(t, 3),
+               gbps=round(E_pad * F * b / t / 1e6, 1))
+        if cfg.pallas and on_tpu:
+            from dgraph_tpu.ops.pallas_segment import (
+                max_vblocks_hint,
+                sorted_row_gather,
+            )
+
+            mv = max_vblocks_hint(sids_np, N)
+            mc0 = max_chunks_hint(sids_np, N)
+            prec0 = "default" if dt == jnp.bfloat16 else "highest"
+            t = bench(
+                lambda a: sorted_row_gather(
+                    a, sids, max_vblocks=mv, scatter_mc=mc0, precision=prec0,
+                ),
+                x,
+            )
+            record(op="gather_sorted_pallas", F=F, dtype=dname, mv=mv,
+                   ms=round(t, 3), gbps=round(E_pad * F * b / t / 1e6, 1))
         t = bench(
             lambda a: local_ops.segment_sum(a, sids, N, indices_are_sorted=True), ed
         )

@@ -24,6 +24,12 @@ date -u +"%Y-%m-%dT%H:%M:%SZ sweep done rc=$?"
 probe && DGRAPH_BENCH_TIMEOUT=3000 python bench.py > logs/bench_r3.json 2>logs/bench_r3.err
 date -u +"%Y-%m-%dT%H:%M:%SZ bench done rc=$? $(cat logs/bench_r3.json 2>/dev/null | tail -1)"
 
+# 3b. gather-kernel A/B: same bench with the sorted-row-gather kernel
+#     pinned on (self-check-vetoed). Compare value vs logs/bench_r3.json.
+probe && DGRAPH_TPU_PALLAS_GATHER=1 DGRAPH_BENCH_TIMEOUT=3000 \
+  python bench.py > logs/bench_r3_gatherk.json 2>logs/bench_r3_gatherk.err
+date -u +"%Y-%m-%dT%H:%M:%SZ bench+gatherk done rc=$? $(tail -1 logs/bench_r3_gatherk.json 2>/dev/null)"
+
 # 4. papers100M ladder: ascending fractions, stop at first failure
 #    (a success is recorded before risking an OOM at the next rung)
 for s in 0.002 0.005 0.01 0.02; do

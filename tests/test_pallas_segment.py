@@ -230,3 +230,111 @@ class TestFusedBiasRelu:
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
         )
+
+
+class TestSortedRowGather:
+    """The transpose kernel: x[ids] for sorted ids as blocked one-hot MXU
+    matmuls (interpret mode on CPU; the chip self-check gates real Mosaic)."""
+
+    def _case(self, seed=0, N=2000, E=8192, F=128, masked_tail=100):
+        rng = np.random.default_rng(seed)
+        ids = np.sort(rng.integers(0, N, E)).astype(np.int32)
+        if masked_tail:
+            ids[-masked_tail:] = N + 1
+        x = rng.standard_normal((N, F)).astype(np.float32)
+        want = np.where((ids < N)[:, None], x[np.clip(ids, 0, N - 1)], 0.0)
+        return x, ids, want
+
+    def test_matches_numpy_with_masked_tail(self):
+        from dgraph_tpu.ops.pallas_segment import (
+            max_chunks_hint,
+            max_vblocks_hint,
+            sorted_row_gather,
+        )
+
+        x, ids, want = self._case()
+        mv = max_vblocks_hint(ids, x.shape[0])
+        mc = max_chunks_hint(ids, x.shape[0])
+        got = np.asarray(sorted_row_gather(
+            jnp.asarray(x), jnp.asarray(ids), max_vblocks=mv, scatter_mc=mc,
+            interpret=True, precision="highest",
+        ))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_odd_sizes_and_tiles(self):
+        from dgraph_tpu.ops.pallas_segment import (
+            max_chunks_hint,
+            max_vblocks_hint,
+            sorted_row_gather,
+        )
+
+        # non-multiple N and E force the padding paths in the schedule
+        x, ids, want = self._case(seed=3, N=777, E=3001, F=64, masked_tail=7)
+        for be, bn in [(256, 128), (1024, 512)]:
+            mv = max_vblocks_hint(ids, x.shape[0], block_e=be, block_n=bn)
+            mc = max_chunks_hint(ids, x.shape[0], block_e=be, block_n=bn)
+            got = np.asarray(sorted_row_gather(
+                jnp.asarray(x), jnp.asarray(ids), max_vblocks=mv,
+                block_e=be, block_n=bn, scatter_mc=mc, interpret=True,
+                precision="highest",
+            ))
+            np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6,
+                                       err_msg=f"tiles ({be},{bn})")
+
+    def test_vjp_is_sorted_segment_sum(self):
+        from dgraph_tpu.ops.pallas_segment import (
+            max_chunks_hint,
+            max_vblocks_hint,
+            sorted_row_gather,
+        )
+
+        x, ids, _ = self._case(seed=5)
+        N = x.shape[0]
+        mv = max_vblocks_hint(ids, N)
+        mc = max_chunks_hint(ids, N)
+        rng = np.random.default_rng(6)
+        g = rng.standard_normal((ids.shape[0], x.shape[1])).astype(np.float32)
+
+        def loss(xx):
+            out = sorted_row_gather(
+                xx, jnp.asarray(ids), max_vblocks=mv, scatter_mc=mc,
+                interpret=True, precision="highest",
+            )
+            return (out * jnp.asarray(g)).sum()
+
+        dx = np.asarray(jax.grad(loss)(jnp.asarray(x)))
+        want = np.zeros_like(x)
+        np.add.at(want, ids[ids < N], g[ids < N])
+        np.testing.assert_allclose(dx, want, rtol=1e-5, atol=1e-5)
+
+    def test_take_rows_routes_to_kernel_when_pinned(self):
+        """config.use_pallas_gather=True + sorted hints must swap the
+        forward to the kernel (structural: pallas_call in the jaxpr);
+        auto must NOT (explicit-opt-in contract)."""
+        from dgraph_tpu import config as cfg
+        from dgraph_tpu.ops import local as L
+
+        x = jnp.zeros((512, 32), jnp.float32)
+        ids = jnp.asarray(np.sort(np.random.default_rng(0).integers(
+            0, 512, 1024)).astype(np.int32))
+
+        def has_pallas(flag):
+            old = cfg.use_pallas_gather
+            try:
+                cfg.set_flags(use_pallas_gather=flag)
+                jx = jax.make_jaxpr(lambda a: L.take_rows(
+                    a, ids, indices_are_sorted=True,
+                    pallas_hints=(512, 256, 2), gather_mv=2,
+                ))(x)
+                return "pallas_call" in str(jx)
+            finally:
+                cfg.set_flags(use_pallas_gather=old)
+
+        # off-TPU take_rows also gates on backend; emulate the TPU branch
+        # by checking _make_take_rows directly
+        from dgraph_tpu.ops.local import _make_take_rows
+
+        fn = _make_take_rows(512, True, 128, True, 512, 256, 2, 2)
+        jx = jax.make_jaxpr(lambda a: fn(a, ids))(x)
+        assert "pallas_call" in str(jx), "mv>0 must route to the kernel"
+        assert has_pallas(None) is False  # auto = OFF on CPU regardless
