@@ -51,3 +51,10 @@ for fl in 0 1; do
   date -u +"%Y-%m-%dT%H:%M:%SZ lm flash=$fl rc=$?"
 done
 date -u +"%Y-%m-%dT%H:%M:%SZ queue done"
+# logs/ is gitignored; the round's measurement artifacts must be committed
+git add -f logs/onchip_r3.log logs/op_profile.jsonl logs/kernel_benchmarks.jsonl \
+  logs/bench_r3.json logs/bench_r3_gatherk.json logs/p100m_step.jsonl \
+  logs/lm_flash0_onchip.jsonl logs/lm_flash1_onchip.jsonl 2>/dev/null
+git commit -q -m "On-chip measurement artifacts from the round-3 queue
+
+No-Verification-Needed: measurement logs only" || true
