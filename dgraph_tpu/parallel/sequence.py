@@ -249,10 +249,16 @@ def _flash_applicable(qh: jax.Array, *, require_pinned: bool = False) -> bool:
     """
     from dgraph_tpu import config as _cfg
 
+    if jax.default_backend() != "tpu":
+        return False  # the kernel is Mosaic-only; a pinned flag on CPU
+        # must not trace it (every other Pallas gate has this check)
     if require_pinned:
         if _cfg.use_flash_attention is not True:
             return False
-    elif not _cfg.flash_attention_enabled():
+    elif not (_cfg.flash_attention_enabled() and _flash_verified):
+        # auto engages only after a chip self-check latched success this
+        # process (the scatter kernels' central-veto discipline); an
+        # explicit pinned True is the operator's override
         return False
     T, _, D = qh.shape
     return T % 128 == 0 and D % 128 == 0
@@ -282,12 +288,18 @@ def _flash_dense(qh, kh, vh, *, causal, scale, kv_mask):
     return out[0].transpose(1, 0, 2).astype(qh.dtype)
 
 
+# Auto-mode flash engages only after flash_attention_selfcheck() passes
+# in this process (pinned config True bypasses — operator override).
+_flash_verified = False
+
+
 def flash_attention_selfcheck() -> bool:
     """Chip-gated equivalence check vs :func:`dense_attention` (the same
     Mosaic-divergence rationale as bench.py's scatter self-checks: the
-    kernel class is invisible to CPU CI). Call before trusting
-    ``use_flash_attention`` on a new chip/toolchain; returns False off-TPU.
+    kernel class is invisible to CPU CI). Passing LATCHES auto-mode flash
+    on for this process; returns False off-TPU.
     """
+    global _flash_verified
     import numpy as np
 
     if jax.default_backend() != "tpu":
@@ -312,6 +324,7 @@ def flash_attention_selfcheck() -> bool:
                 return False
     except Exception:
         return False
+    _flash_verified = True
     return True
 
 

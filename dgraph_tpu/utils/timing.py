@@ -109,7 +109,17 @@ def salt_input(a, salt):
     constant despite float NaN/Inf semantics, severed the chain, and
     loop-invariant code motion hoisted the op — producing impossible
     ~0 ms "measurements" (caught in r3 via a 0.011 ms 240k-row gather).
+
+    FLOAT inputs only: for integer dtypes the 1e-20 scale would cast to
+    exactly 0 and silently reopen the hole, so that's a hard error.
     """
+    import jax.numpy as jnp
+
+    if not jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+        raise TypeError(
+            f"salt_input needs a float array (got {jnp.asarray(a).dtype}): "
+            f"an integer cast of salt*1e-20 is exactly 0, which severs the "
+            f"loop-carried dependence the hoist-proofing relies on")
     return a + (salt * 1e-20).astype(a.dtype)
 
 

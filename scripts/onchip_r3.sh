@@ -11,44 +11,47 @@ import jax, jax.numpy as jnp
 assert jax.default_backend() == 'tpu', jax.default_backend()
 float(jnp.ones((8,128)).sum())" >/dev/null 2>&1; }
 
+# run_stage NAME CMD...: probe first (a failed probe logs "skipped
+# (wedged)", NOT a stage rc — the artifact must distinguish
+# never-started from crashed), then run and log the stage's own rc.
+run_stage() {
+  name=$1; shift
+  if ! probe; then
+    date -u +"%Y-%m-%dT%H:%M:%SZ $name skipped (lease wedged)"
+    return 1
+  fi
+  "$@"
+  rc=$?
+  date -u +"%Y-%m-%dT%H:%M:%SZ $name done rc=$rc"
+  return $rc
+}
+
 # 1. op profile (VERDICT #2: explain the epoch residual)
-probe && timeout 1500 python experiments/op_profile.py 2>&1 | tail -20
-date -u +"%Y-%m-%dT%H:%M:%SZ op_profile done rc=$?"
+run_stage op_profile bash -c 'set -o pipefail; timeout 1500 python experiments/op_profile.py 2>&1 | tail -20'
 
 # 2. kernel tile sweep (VERDICT #3)
-probe && timeout 2400 python experiments/kernel_benchmarks.py --sweep true --dtypes float32,bfloat16 2>&1 | tail -30
-date -u +"%Y-%m-%dT%H:%M:%SZ sweep done rc=$?"
+run_stage sweep bash -c 'set -o pipefail; timeout 2400 python experiments/kernel_benchmarks.py --sweep true --dtypes float32,bfloat16 2>&1 | tail -30'
 
 # 3. full bench (GCN epoch + GraphCast level 6) — supervisor makes this
 #    un-losable; budget generous since the queue owns the window
-probe && DGRAPH_BENCH_TIMEOUT=3000 python bench.py > logs/bench_r3.json 2>logs/bench_r3.err
-date -u +"%Y-%m-%dT%H:%M:%SZ bench done rc=$? $(cat logs/bench_r3.json 2>/dev/null | tail -1)"
+run_stage bench bash -c 'DGRAPH_BENCH_TIMEOUT=3000 python bench.py > logs/bench_r3.json 2>logs/bench_r3.err'
+date -u +"%Y-%m-%dT%H:%M:%SZ bench json: $(tail -1 logs/bench_r3.json 2>/dev/null)"
 
 # 3b. gather-kernel A/B: same bench with the sorted-row-gather kernel
 #     pinned on (self-check-vetoed). Compare value vs logs/bench_r3.json.
-probe && DGRAPH_TPU_PALLAS_GATHER=1 DGRAPH_BENCH_TIMEOUT=3000 \
-  python bench.py > logs/bench_r3_gatherk.json 2>logs/bench_r3_gatherk.err
-date -u +"%Y-%m-%dT%H:%M:%SZ bench+gatherk done rc=$? $(tail -1 logs/bench_r3_gatherk.json 2>/dev/null)"
+run_stage bench_gatherk bash -c 'DGRAPH_TPU_PALLAS_GATHER=1 DGRAPH_BENCH_TIMEOUT=3000 python bench.py > logs/bench_r3_gatherk.json 2>logs/bench_r3_gatherk.err'
+date -u +"%Y-%m-%dT%H:%M:%SZ gatherk json: $(tail -1 logs/bench_r3_gatherk.json 2>/dev/null)"
 
 # 4. papers100M ladder: ascending fractions, stop at first failure
 #    (a success is recorded before risking an OOM at the next rung)
 for s in 0.002 0.005 0.01 0.02; do
-  probe || break
-  timeout 2400 python experiments/papers100m_gcn.py --synthetic_scale $s \
-    --epochs 3 --world_size 1 --log_path logs/p100m_step.jsonl 2>&1 | tail -5
-  rc=$?
-  date -u +"%Y-%m-%dT%H:%M:%SZ p100m scale=$s rc=$rc"
-  [ $rc -ne 0 ] && break
+  run_stage "p100m scale=$s" bash -c "set -o pipefail; timeout 2400 python experiments/papers100m_gcn.py --synthetic_scale $s --epochs 3 --world_size 1 --log_path logs/p100m_step.jsonl 2>&1 | tail -5" || break
 done
 # 5. long-context attention A/B on one chip: Ulysses dense stage with the
 #    Mosaic flash kernel (self-check-gated) vs the XLA dense path
 #    (seq 8192, head_dim 128 — flash shape gate satisfied)
 for fl in 0 1; do
-  probe || break
-  DGRAPH_TPU_FLASH_ATTN=$fl timeout 1200 python experiments/long_context_lm.py \
-    --seq_len 8192 --steps 30 --world_size 1 --latent 256 --num_heads 2 \
-    --attn_impl ulysses --log_path logs/lm_flash${fl}_onchip.jsonl 2>&1 | tail -2
-  date -u +"%Y-%m-%dT%H:%M:%SZ lm flash=$fl rc=$?"
+  run_stage "lm flash=$fl" bash -c "set -o pipefail; DGRAPH_TPU_FLASH_ATTN=$fl timeout 1200 python experiments/long_context_lm.py --seq_len 8192 --steps 30 --world_size 1 --latent 256 --num_heads 2 --attn_impl ulysses --log_path logs/lm_flash${fl}_onchip.jsonl 2>&1 | tail -2" || break
 done
 date -u +"%Y-%m-%dT%H:%M:%SZ queue done"
 # logs/ is gitignored; the round's measurement artifacts must be committed

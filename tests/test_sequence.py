@@ -240,28 +240,22 @@ def test_comm_seq_attention_impl_routing():
 
 
 def test_flash_gating_off_tpu():
-    """On CPU the flash path must never engage: auto resolves by backend,
-    and even a pinned-ON flag is shape-gated (CI never traces the Mosaic
-    kernel by accident)."""
+    """On CPU the flash path must NEVER engage — not in auto, and (since
+    the r3 hardening) not even when the flag is pinned True: the kernel
+    is Mosaic-only and a pinned flag copied from a TPU runbook must not
+    crash CPU runs. Auto additionally requires the chip self-check latch."""
     from dgraph_tpu import config as cfg
-    from dgraph_tpu.parallel.sequence import (
-        _flash_applicable,
-        flash_attention_selfcheck,
-    )
+    from dgraph_tpu.parallel import sequence as seq
 
     q = jnp.zeros((256, 2, 128), jnp.float32)
     old = cfg.use_flash_attention
     try:
-        cfg.set_flags(use_flash_attention=None)  # auto -> backend == tpu
-        assert _flash_applicable(q) is False
-        cfg.set_flags(use_flash_attention=True)  # pinned: shape gate rules
-        assert _flash_applicable(q) is True
-        # the single-comm oracle site engages only on the explicit pin
-        assert _flash_applicable(q, require_pinned=True) is True
-        cfg.set_flags(use_flash_attention=None)
-        assert _flash_applicable(q, require_pinned=True) is False
-        assert _flash_applicable(jnp.zeros((250, 2, 128))) is False
-        assert _flash_applicable(jnp.zeros((256, 2, 64))) is False
+        cfg.set_flags(use_flash_attention=None)  # auto
+        assert seq._flash_applicable(q) is False
+        cfg.set_flags(use_flash_attention=True)  # pinned — still CPU
+        assert seq._flash_applicable(q) is False
+        assert seq._flash_applicable(q, require_pinned=True) is False
     finally:
         cfg.set_flags(use_flash_attention=old)
-    assert flash_attention_selfcheck() is False  # off-TPU: no verdict
+    assert seq.flash_attention_selfcheck() is False  # off-TPU: no verdict
+    assert seq._flash_verified is False  # and the auto latch stays cold
