@@ -835,7 +835,7 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
         last = (stdout or "").strip().splitlines()
         if (p.returncode == EXIT_BACKEND
                 and time.time() < deadline - 120):
-            log(f"child {spawn} lost its backend (rc=5); re-probing and "
+            log(f"child {spawn} lost its backend (rc=5); waiting 30s and "
                 f"respawning with {int(deadline - time.time())}s left")
             time.sleep(30)
             continue

@@ -30,6 +30,11 @@ class TransformerBlock(nn.Module):
     dtype: Any = None
     causal: bool = True
     attn_impl: str = "ring"  # or 'ulysses' (heads % axis == 0)
+    # MoE FFN: one expert per rank of the SEQUENCE axis (the classic
+    # DeepSpeed-MoE axis fusion — tokens are already sharded over it, so
+    # routing is the standard two all_to_alls). 0 = dense FFN.
+    moe_k: int = 0  # top-k routing (1 = switch, 2 = GShard/Mixtral)
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x):  # [T_loc, L]
@@ -50,8 +55,44 @@ class TransformerBlock(nn.Module):
         )
         x = x + nn.Dense(L, dtype=dt, name="attn_out")(attn.reshape(n, L))
         y = nn.LayerNorm(dtype=dt, name="ln_ffn")(x)
+        if self.moe_k > 0 and self.comm.graph_axis is not None:
+            return x + self._moe_ffn(y, dt)
         h = nn.silu(nn.Dense(4 * L, dtype=dt, name="ffn_up")(y))
         return x + nn.Dense(L, dtype=dt, name="ffn_down")(h)
+
+    def _moe_ffn(self, y, dt):
+        """Expert-parallel FFN over the sequence axis. Expert weights carry
+        a leading [1] axis per shard (global [E, ...], sharded over the
+        axis — :func:`moe_param_specs` derives the per-leaf partition
+        specs); all experts share the
+        same init and diverge through routing. The router's load-balance
+        loss is stashed in a mutable 'losses' collection."""
+        from dgraph_tpu.parallel.expert import load_balance_loss, moe_apply
+
+        L = self.latent
+        E = self.comm.get_world_size()
+        T_loc = y.shape[0]
+        cap = max(1, int(self.moe_capacity_factor * self.moe_k * T_loc / E))
+        logits = nn.Dense(E, dtype=dt, name="router")(y)
+        w1 = self.param(
+            "moe_w1", nn.initializers.lecun_normal(), (1, L, 4 * L))
+        w2 = self.param(
+            "moe_w2", nn.initializers.lecun_normal(), (1, 4 * L, L))
+
+        def expert_fn(p, z):
+            h = nn.silu(z @ p["w1"].astype(z.dtype))
+            return h @ p["w2"].astype(z.dtype)
+
+        out = moe_apply(
+            y, logits, expert_fn, {"w1": w1[0], "w2": w2[0]}, cap,
+            self.comm.graph_axis, k=self.moe_k,
+        )
+        if self.is_mutable_collection("losses"):
+            self.sow(
+                "losses", "moe_aux",
+                load_balance_loss(logits, self.comm.graph_axis),
+            )
+        return out
 
 
 class SeqTransformerLM(nn.Module):
@@ -67,6 +108,8 @@ class SeqTransformerLM(nn.Module):
     comm: Any = None
     dtype: Any = None
     attn_impl: str = "ring"
+    moe_k: int = 0  # >0: expert-parallel FFN over the sequence axis
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, tokens, positions):  # [T_loc] int32, [T_loc] int32
@@ -76,7 +119,26 @@ class SeqTransformerLM(nn.Module):
             h = TransformerBlock(
                 self.latent, self.num_heads, comm=self.comm,
                 dtype=self.dtype, attn_impl=self.attn_impl,
+                moe_k=self.moe_k,
+                moe_capacity_factor=self.moe_capacity_factor,
                 name=f"block_{i}",
             )(h)
         h = nn.LayerNorm(name="ln_out")(h)
         return nn.Dense(self.vocab, name="head")(h).astype(jnp.float32)
+
+
+def moe_param_specs(params_or_shapes, axis_name: str = "graph"):
+    """Per-leaf PartitionSpecs for an LM param tree: MoE expert weights
+    (``moe_w*`` leaves, global [E, ...]) shard over ``axis_name``;
+    everything else replicates. The ONE place the leading-[1]-per-shard
+    convention and the ``moe_w`` naming are interpreted — derive specs
+    here, never by hand (a silently replicated expert leaf trains one
+    shared expert while reporting E of them)."""
+    from jax.sharding import PartitionSpec as P
+    from jax.tree_util import tree_map_with_path
+
+    def spec(path, _leaf):
+        names = "/".join(str(getattr(k, "key", k)) for k in path)
+        return P(axis_name) if "moe_w" in names else P()
+
+    return tree_map_with_path(spec, params_or_shapes)

@@ -252,10 +252,12 @@ def _flash_applicable(qh: jax.Array, *, require_pinned: bool = False) -> bool:
     if jax.default_backend() != "tpu":
         return False  # the kernel is Mosaic-only; a pinned flag on CPU
         # must not trace it (every other Pallas gate has this check)
-    if require_pinned:
-        if _cfg.use_flash_attention is not True:
-            return False
-    elif not (_cfg.flash_attention_enabled() and _flash_verified):
+    pinned = _cfg.use_flash_attention is True
+    if require_pinned and not pinned:
+        return False
+    if not pinned and not (
+        _cfg.flash_attention_enabled() and _flash_verified
+    ):
         # auto engages only after a chip self-check latched success this
         # process (the scatter kernels' central-veto discipline); an
         # explicit pinned True is the operator's override

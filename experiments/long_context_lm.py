@@ -39,6 +39,10 @@ class Config:
     # full-sequence dense stage uses the Mosaic flash kernel on TPU when
     # config.use_flash_attention allows AND the chip self-check passes)
     attn_impl: str = "ring"
+    # >0: expert-parallel MoE FFN over the same axis (one expert per rank,
+    # DeepSpeed-MoE axis fusion); k = experts per token
+    moe_k: int = 0
+    moe_aux_weight: float = 0.01
     seed: int = 0
     log_path: str = "logs/long_context_lm.jsonl"
     log_every: int = 20
@@ -74,7 +78,7 @@ def main(cfg: Config):
     model = SeqTransformerLM(
         vocab=cfg.vocab, latent=cfg.latent, num_layers=cfg.num_layers,
         num_heads=cfg.num_heads, max_len=T, comm=comm,
-        attn_impl=cfg.attn_impl,
+        attn_impl=cfg.attn_impl, moe_k=cfg.moe_k,
     )
     rng = np.random.default_rng(cfg.seed)
     pos = jnp.arange(T, dtype=jnp.int32)
@@ -89,7 +93,13 @@ def main(cfg: Config):
         # neighbor's first token (fetched by ppermute), so the objective —
         # and the logged loss — is identical for any world size
         # (ADVICE r2 #3: W=1 vs W=8 curves must be comparable).
-        logits = model.apply(params, toks, pos)
+        aux = 0.0
+        if cfg.moe_k > 0:
+            logits, mut = model.apply(params, toks, pos, mutable=["losses"])
+            aux = sum(jnp.sum(v) for v in jax.tree.leaves(mut))
+            aux = cfg.moe_aux_weight * aux / max(cfg.num_layers, 1)
+        else:
+            logits = model.apply(params, toks, pos)
         left = [(i, (i - 1) % W) for i in range(W)]
         nxt = jax.lax.ppermute(toks[:1], "graph", left)
         targets = jnp.concatenate([toks[1:], nxt])
@@ -102,19 +112,36 @@ def main(cfg: Config):
         valid = jnp.where(
             is_last, jnp.arange(t_loc) < t_loc - 1, jnp.ones(t_loc, bool)
         )
-        return -jax.lax.psum((ll * valid).sum(), "graph") / (T - 1)
+        return (
+            -jax.lax.psum((ll * valid).sum(), "graph") / (T - 1) + aux
+        )
+
+    from dgraph_tpu.models.transformer import moe_param_specs
+
+    toks0 = batch()
+    # paths only (the MoE blocks trace collectives, so even shape
+    # derivation must run under shard_map; out_specs=P() is fine for
+    # PATH discovery — the real init below uses the derived specs)
+    shapes = jax.eval_shape(
+        jax.shard_map(
+            lambda tk, ps: model.init(jax.random.key(cfg.seed), tk, ps),
+            mesh=mesh, in_specs=(P("graph"), P("graph")), out_specs=P(),
+            check_vma=False,
+        ),
+        toks0, pos,
+    )
+    pspecs = moe_param_specs(shapes)
 
     loss_sm = jax.shard_map(
         shard_loss, mesh=mesh,
-        in_specs=(P(), P("graph"), P("graph")), out_specs=P(),
+        in_specs=(pspecs, P("graph"), P("graph")), out_specs=P(),
         check_vma=False,
     )
 
-    toks0 = batch()
     with jax.set_mesh(mesh):
         params = jax.shard_map(
             lambda tk, ps: model.init(jax.random.key(cfg.seed), tk, ps),
-            mesh=mesh, in_specs=(P("graph"), P("graph")), out_specs=P(),
+            mesh=mesh, in_specs=(P("graph"), P("graph")), out_specs=pspecs,
             check_vma=False,
         )(toks0, pos)
         opt = optax.adam(cfg.lr)

@@ -35,12 +35,12 @@ run_stage sweep bash -c 'set -o pipefail; timeout 2400 python experiments/kernel
 # 3. full bench (GCN epoch + GraphCast level 6) — supervisor makes this
 #    un-losable; budget generous since the queue owns the window
 run_stage bench bash -c 'DGRAPH_BENCH_TIMEOUT=3000 python bench.py > logs/bench_r3.json 2>logs/bench_r3.err'
-date -u +"%Y-%m-%dT%H:%M:%SZ bench json: $(tail -1 logs/bench_r3.json 2>/dev/null)"
+[ $? -eq 0 ] && date -u +"%Y-%m-%dT%H:%M:%SZ bench json: $(tail -1 logs/bench_r3.json 2>/dev/null)"
 
 # 3b. gather-kernel A/B: same bench with the sorted-row-gather kernel
 #     pinned on (self-check-vetoed). Compare value vs logs/bench_r3.json.
 run_stage bench_gatherk bash -c 'DGRAPH_TPU_PALLAS_GATHER=1 DGRAPH_BENCH_TIMEOUT=3000 python bench.py > logs/bench_r3_gatherk.json 2>logs/bench_r3_gatherk.err'
-date -u +"%Y-%m-%dT%H:%M:%SZ gatherk json: $(tail -1 logs/bench_r3_gatherk.json 2>/dev/null)"
+[ $? -eq 0 ] && date -u +"%Y-%m-%dT%H:%M:%SZ gatherk json: $(tail -1 logs/bench_r3_gatherk.json 2>/dev/null)"
 
 # 4. papers100M ladder: ascending fractions, stop at first failure
 #    (a success is recorded before risking an OOM at the next rung)

@@ -259,3 +259,26 @@ def test_flash_gating_off_tpu():
         cfg.set_flags(use_flash_attention=old)
     assert seq.flash_attention_selfcheck() is False  # off-TPU: no verdict
     assert seq._flash_verified is False  # and the auto latch stays cold
+
+
+def test_flash_shape_gate(monkeypatch):
+    """The T%128 / D%128 shape gate, exercised on CPU by faking the
+    backend (the real backend check short-circuits first otherwise — a
+    broken shape gate must not wait for a scarce TPU window to surface)."""
+    from dgraph_tpu import config as cfg
+    from dgraph_tpu.parallel import sequence as seq
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    old = cfg.use_flash_attention
+    try:
+        cfg.set_flags(use_flash_attention=True)  # pinned: operator override
+        assert seq._flash_applicable(jnp.zeros((256, 2, 128))) is True
+        assert seq._flash_applicable(
+            jnp.zeros((256, 2, 128)), require_pinned=True) is True
+        assert seq._flash_applicable(jnp.zeros((250, 2, 128))) is False
+        assert seq._flash_applicable(jnp.zeros((256, 2, 64))) is False
+        # auto (None) needs the self-check latch even on "tpu"
+        cfg.set_flags(use_flash_attention=None)
+        assert seq._flash_applicable(jnp.zeros((256, 2, 128))) is False
+    finally:
+        cfg.set_flags(use_flash_attention=old)
