@@ -149,6 +149,23 @@ def main(cfg: Config):
                     record(op=f"segment_sum_pallas_{prec}", F=F, dtype=dname,
                            block_e=be, block_n=bn, mc=mc, ms=round(t, 3),
                            gbps=round(E_pad * F * b / t / 1e6, 1))
+                # the gather kernel shares the plan's (block_e, block_n)
+                # fields, so tile winners must be picked for BOTH kernels
+                if cfg.sweep:
+                    # max_vblocks_hint / sorted_row_gather / prec0 are in
+                    # scope from the non-sweep gather block above (same
+                    # cfg.pallas-and-on_tpu guard)
+                    mv = max_vblocks_hint(sids_np, N, block_e=be, block_n=bn)
+                    t = bench(
+                        lambda a, be=be, bn=bn, mv=mv, mc=mc, prec0=prec0:
+                        sorted_row_gather(
+                            a, sids, max_vblocks=mv, block_e=be, block_n=bn,
+                            scatter_mc=mc, precision=prec0),
+                        x,
+                    )
+                    record(op="gather_sorted_pallas_sweep", F=F, dtype=dname,
+                           block_e=be, block_n=bn, mv=mv, ms=round(t, 3),
+                           gbps=round(E_pad * F * b / t / 1e6, 1))
 
     if cfg.out:
         os.makedirs(os.path.dirname(cfg.out) or ".", exist_ok=True)
