@@ -541,18 +541,20 @@ def _expected_platform():
     return "tpu"
 
 
-def _init_backend_with_retry(budget: int):
+def _init_backend_fail_fast():
     """jax.devices() raises UNAVAILABLE when the tunnel lease is wedged at
-    startup — the exact failure that zeroed BENCH_r01+r02. The lease is
-    known to recover on its own, so retry inside a fraction of the watchdog
-    budget before emitting the structured failure JSON."""
+    startup — the exact failure that zeroed BENCH_r01+r02. JAX caches a
+    failed (or wrong-platform) backend init IN-PROCESS, so in-child
+    retries mostly re-raise the cached error; the retry that actually
+    works is the supervisor's fresh-process respawn (rc=EXIT_BACKEND →
+    phase-2 respawn loop). One immediate second attempt covers the only
+    in-process-recoverable case (a transient RPC error before the cache
+    is populated); anything else fails fast (ADVICE r3 #1)."""
     import jax
 
     want = _expected_platform()
-    deadline = time.time() + 0.5 * budget
-    attempt = 0
-    while True:
-        attempt += 1
+    last = None
+    for attempt in (1, 2):
         try:
             devs = jax.devices()
             got = jax.default_backend()
@@ -564,15 +566,16 @@ def _init_backend_with_retry(budget: int):
                     f"fallback from a wedged lease?)", EXIT_BACKEND)
             log(f"devices ({got}): {devs}")
             return
-        except Exception as e:  # noqa: BLE001 — any init failure retries
+        except Exception as e:  # noqa: BLE001
             last = f"{type(e).__name__}: {e}"
-            log(f"backend init attempt {attempt} failed: {last.splitlines()[0]}")
-            if time.time() >= deadline:
-                _emit_json_and_exit(
-                    f"backend init failed after {attempt} attempts: {last}",
-                    EXIT_BACKEND,
-                )
-            time.sleep(min(60, max(5, deadline - time.time())))
+            log(f"backend init attempt {attempt} failed: "
+                f"{last.splitlines()[0]}")
+            if attempt == 1:
+                time.sleep(5)
+    _emit_json_and_exit(
+        f"backend init failed (fail-fast; supervisor respawns): {last}",
+        EXIT_BACKEND,
+    )
 
 
 def _hbm_peak_gb():
@@ -593,11 +596,11 @@ def _hbm_peak_gb():
 
 def _child_main():
     t_start = time.time()
-    budget = _arm_watchdog()
+    _arm_watchdog()
     log("importing jax...")
     import jax  # noqa: F401
 
-    _init_backend_with_retry(budget)
+    _init_backend_fail_fast()
 
     from dgraph_tpu import config as cfg
 

@@ -55,7 +55,16 @@ class TransformerBlock(nn.Module):
         )
         x = x + nn.Dense(L, dtype=dt, name="attn_out")(attn.reshape(n, L))
         y = nn.LayerNorm(dtype=dt, name="ln_ffn")(x)
-        if self.moe_k > 0 and self.comm.graph_axis is not None:
+        if self.moe_k > 0:
+            if self.comm.graph_axis is None:
+                # a silent dense fallback would be a DIFFERENT architecture
+                # (no router/expert params) masquerading as the same config
+                # (ADVICE r3 #3) — fail loudly instead
+                raise ValueError(
+                    "moe_k > 0 needs a sharded communicator (graph_axis); "
+                    "SingleComm has no expert axis. Run with world_size > 1 "
+                    "or set moe_k=0."
+                )
             return x + self._moe_ffn(y, dt)
         h = nn.silu(nn.Dense(4 * L, dtype=dt, name="ffn_up")(y))
         return x + nn.Dense(L, dtype=dt, name="ffn_down")(h)
@@ -138,7 +147,10 @@ def moe_param_specs(params_or_shapes, axis_name: str = "graph"):
     from jax.tree_util import tree_map_with_path
 
     def spec(path, _leaf):
-        names = "/".join(str(getattr(k, "key", k)) for k in path)
-        return P(axis_name) if "moe_w" in names else P()
+        # match the FINAL path component exactly: a future 'moe_weight_norm'
+        # or a parent module named 'moe_w*' must not silently shard
+        # (ADVICE r3 #4)
+        leaf_name = str(getattr(path[-1], "key", path[-1])) if path else ""
+        return P(axis_name) if leaf_name in ("moe_w1", "moe_w2") else P()
 
     return tree_map_with_path(spec, params_or_shapes)

@@ -101,6 +101,7 @@ def ring_attention(
     m0 = vary(jnp.full((T, H), NEG_BIG, jnp.float32))
     l0 = vary(jnp.zeros((T, H), jnp.float32))
     o0 = vary(jnp.zeros((T, H, D), jnp.float32))
+    had_mask = kv_mask is not None
     if kv_mask is None:
         kv_mask = vary(jnp.ones((T,), jnp.float32))
 
@@ -150,7 +151,19 @@ def ring_attention(
     )
     # fully-masked rows (all-padding shard under kv_mask) have l == 0
     out = o / jnp.maximum(l, 1e-30)[..., None]
+    if had_mask:
+        # kv_mask here is the un-rotated local shard mask = this shard's
+        # query-row mask
+        out = _zero_padded_rows(out, kv_mask)
     return out.astype(q.dtype)
+
+
+def _zero_padded_rows(out: jax.Array, kv_mask: jax.Array) -> jax.Array:
+    """The contract every attention impl shares (dense/ring/ulysses/flash):
+    PADDED QUERY ROWS ARE ZERO, so full-tensor outputs agree across
+    implementations instead of diverging on don't-care rows (ADVICE r3 #2).
+    ``out`` is [T, H, D]; ``kv_mask`` is the [T] query-position mask."""
+    return out * (kv_mask > 0).astype(out.dtype)[:, None, None]
 
 
 def dense_attention(
@@ -177,6 +190,8 @@ def dense_attention(
     p = jax.nn.softmax(logits, axis=-1)
     p = p * allowed[:, None, :]
     out = jnp.einsum("ths,shd->thd", p, v.astype(jnp.float32))
+    if kv_mask is not None:
+        out = _zero_padded_rows(out, kv_mask)
     return out.astype(q.dtype)
 
 
@@ -287,7 +302,12 @@ def _flash_dense(qh, kh, vh, *, causal, scale, kv_mask):
         to_k(qh), to_k(kh), to_k(vh), segment_ids=seg, causal=causal,
         sm_scale=float(scale),
     )
-    return out[0].transpose(1, 0, 2).astype(qh.dtype)
+    res = out[0].transpose(1, 0, 2)
+    if kv_mask is not None:
+        # without this the flash path's padded rows attend the padding
+        # SEGMENT while the dense oracle's attend real keys
+        res = _zero_padded_rows(res, kv_mask)
+    return res.astype(qh.dtype)
 
 
 # Auto-mode flash engages only after flash_attention_selfcheck() passes

@@ -69,6 +69,26 @@ def restore_checkpoint(ckpt_dir: str, template: dict, step: Optional[int] = None
         return ckptr.restore(path, item=template)
 
 
+def checkpoint_keys(ckpt_dir: str, step: Optional[int] = None):
+    """Top-level pytree keys of the given (or latest) checkpoint, or None
+    if no checkpoint exists. Lets callers pick a restore TEMPLATE from
+    what the checkpoint actually contains (e.g. an 'ema' track) instead
+    of try/except-ing template mismatches — which would also swallow
+    genuine corruption/IO errors (ADVICE r3 #5)."""
+    import orbax.checkpoint as ocp
+
+    step = step if step is not None else latest_step(ckpt_dir)
+    if step is None:
+        return None
+    path = os.path.abspath(os.path.join(ckpt_dir, f"step_{step:08d}"))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        md = ckptr.metadata(path)
+    tree = getattr(getattr(md, "item_metadata", md), "tree", None)
+    if isinstance(tree, dict):
+        return set(tree.keys())
+    return None
+
+
 # --- plan cache ---
 
 

@@ -53,7 +53,8 @@ def main(cfg: Config):
     from dgraph_tpu.comm.mesh import GRAPH_AXIS, plan_in_specs, squeeze_plan
     from dgraph_tpu.data.weather import SyntheticWeatherDataset
     from dgraph_tpu.models.graphcast import GraphCast, build_graphcast_graphs
-    from dgraph_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
+    from dgraph_tpu.train.checkpoint import (
+        checkpoint_keys, restore_checkpoint, save_checkpoint)
     from dgraph_tpu.train.ema import ema_init, ema_update
     from dgraph_tpu.train.schedules import graphcast_three_phase
     from dgraph_tpu.utils import ExperimentLog, TimingReport
@@ -118,21 +119,35 @@ def main(cfg: Config):
     if cfg.ckpt_dir:
         base = {"params": params, "opt_state": opt_state, "step": 0}
         with_ema = dict(base, ema=ema if ema is not None else ema_init(params))
-        try:
+        # pick the template from what the checkpoint ACTUALLY contains
+        # (ema track present or not) instead of try/except-ing a mismatch —
+        # genuine corruption/IO errors now propagate with their original
+        # traceback (ADVICE r3 #5). A pre-EMA checkpoint under an EMA run
+        # restarts the track from the restored params; an EMA-bearing
+        # checkpoint under ema_decay=0 drops the track.
+        keys = checkpoint_keys(cfg.ckpt_dir)
+        if keys is not None:
+            ckpt_has_ema = "ema" in keys
             restored = restore_checkpoint(
-                cfg.ckpt_dir, with_ema if ema is not None else base)
-        except Exception:
-            # checkpoint layout doesn't match this run's ema_decay config:
-            # retry with the OTHER template — a pre-EMA checkpoint under an
-            # EMA run restarts the track from the restored params; an
-            # EMA-bearing checkpoint under ema_decay=0 drops the track
-            restored = restore_checkpoint(
-                cfg.ckpt_dir, base if ema is not None else with_ema)
-            if restored:
-                if ema is not None:
-                    restored["ema"] = ema_init(restored["params"])
-                else:
-                    restored.pop("ema", None)
+                cfg.ckpt_dir, with_ema if ckpt_has_ema else base)
+        else:
+            # metadata unreadable (older orbax layout / partially synced
+            # dir) but a checkpoint may still exist: fall back to the
+            # two-template probe. A template mismatch is the ONLY error
+            # retried; corruption/IO errors propagate from the retry.
+            ckpt_has_ema = ema is not None
+            try:
+                restored = restore_checkpoint(
+                    cfg.ckpt_dir, with_ema if ckpt_has_ema else base)
+            except Exception:
+                ckpt_has_ema = not ckpt_has_ema
+                restored = restore_checkpoint(
+                    cfg.ckpt_dir, with_ema if ckpt_has_ema else base)
+        if restored:
+            if ema is not None and not ckpt_has_ema:
+                restored["ema"] = ema_init(restored["params"])
+            elif ema is None:
+                restored.pop("ema", None)
         if restored:
             params, opt_state, step_idx = (
                 restored["params"],

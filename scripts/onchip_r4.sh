@@ -1,0 +1,79 @@
+#!/bin/bash
+# Round-4 on-chip queue. Runs the VERDICT-r3-ordered measurements once the
+# TPU lease recovers. Wedge-risk-aware ordering: the headline GCN epoch
+# number is captured and COMMITTED before any stage that has previously
+# wedged the lease (GraphCast level 6 OOM'd and wedged it in r2).
+# Artifacts are committed after EVERY stage, not just at queue end.
+cd /root/repo
+set -o pipefail
+exec >> logs/onchip_r4.log 2>&1
+date -u +"%Y-%m-%dT%H:%M:%SZ r4 queue start"
+
+probe() { timeout 90 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() == 'tpu', jax.default_backend()
+float(jnp.ones((8,128)).sum())" >/dev/null 2>&1; }
+
+commit_stage() {  # commit_stage NAME FILES...
+  name=$1; shift
+  git add -f "$@" logs/onchip_r4.log 2>/dev/null
+  git commit -q -m "On-chip r4 queue: $name artifacts
+
+No-Verification-Needed: measurement logs only" || true
+}
+
+run_stage() {
+  name=$1; shift
+  if ! probe; then
+    date -u +"%Y-%m-%dT%H:%M:%SZ $name skipped (lease wedged)"
+    return 1
+  fi
+  "$@"
+  rc=$?
+  date -u +"%Y-%m-%dT%H:%M:%SZ $name done rc=$rc"
+  return $rc
+}
+
+# 1. Headline number FIRST: GCN-only bench (GraphCast stage disabled).
+#    This is the metric three rounds have failed to produce; nothing
+#    risky runs before it.
+run_stage bench_gcn bash -c 'DGRAPH_BENCH_GRAPHCAST=0 DGRAPH_BENCH_TIMEOUT=2400 python bench.py > logs/bench_r4_gcn.json 2>logs/bench_r4_gcn.err'
+date -u +"%Y-%m-%dT%H:%M:%SZ gcn json: $(tail -1 logs/bench_r4_gcn.json 2>/dev/null)"
+commit_stage bench_gcn logs/bench_r4_gcn.json logs/bench_r4_gcn.err
+
+# 2. Kernel tile sweep (VERDICT r3 #2: settle both gather defaults on the
+#    fixed timing harness; low memory risk).
+run_stage sweep bash -c 'set -o pipefail; timeout 2400 python experiments/kernel_benchmarks.py --sweep true --dtypes float32,bfloat16 2>&1 | tail -30'
+commit_stage sweep logs/kernel_benchmarks.jsonl
+
+# 3. Gather-kernel A/B: GCN bench with the sorted-row-gather kernel
+#    pinned on (self-check-vetoed). Compare value vs logs/bench_r4_gcn.json.
+run_stage bench_gatherk bash -c 'DGRAPH_TPU_PALLAS_GATHER=1 DGRAPH_BENCH_GRAPHCAST=0 DGRAPH_BENCH_TIMEOUT=2400 python bench.py > logs/bench_r4_gatherk.json 2>logs/bench_r4_gatherk.err'
+date -u +"%Y-%m-%dT%H:%M:%SZ gatherk json: $(tail -1 logs/bench_r4_gatherk.json 2>/dev/null)"
+commit_stage bench_gatherk logs/bench_r4_gatherk.json logs/bench_r4_gatherk.err
+
+# 4. op profile (VERDICT r3 #5: explain the 2x epoch residual)
+run_stage op_profile bash -c 'set -o pipefail; timeout 1500 python experiments/op_profile.py 2>&1 | tail -20'
+commit_stage op_profile logs/op_profile.jsonl
+
+# 5. Flash-attention A/B at seq 8192 (VERDICT r3 #8) — before the
+#    known-wedge-risk stages.
+for fl in 0 1; do
+  run_stage "lm flash=$fl" bash -c "set -o pipefail; DGRAPH_TPU_FLASH_ATTN=$fl timeout 1200 python experiments/long_context_lm.py --seq_len 8192 --steps 30 --world_size 1 --latent 256 --num_heads 2 --attn_impl ulysses --log_path logs/lm_flash${fl}_onchip.jsonl 2>&1 | tail -2" || break
+done
+commit_stage flash_ab logs/lm_flash0_onchip.jsonl logs/lm_flash1_onchip.jsonl
+
+# 6. GraphCast level 6 (VERDICT r3 #3). RISK: this exact stage OOM'd and
+#    wedged the lease in r2; everything above is already committed.
+run_stage bench_graphcast bash -c 'DGRAPH_BENCH_TIMEOUT=3000 python bench.py > logs/bench_r4_full.json 2>logs/bench_r4_full.err'
+date -u +"%Y-%m-%dT%H:%M:%SZ full json: $(tail -1 logs/bench_r4_full.json 2>/dev/null)"
+commit_stage bench_graphcast logs/bench_r4_full.json logs/bench_r4_full.err
+
+# 7. papers100M ladder (VERDICT r3 #4): ascending fractions, stop at the
+#    first failure so a success is recorded before risking an OOM.
+for s in 0.002 0.005 0.01 0.02; do
+  run_stage "p100m scale=$s" bash -c "set -o pipefail; timeout 2400 python experiments/papers100m_gcn.py --synthetic_scale $s --epochs 3 --world_size 1 --log_path logs/p100m_step.jsonl 2>&1 | tail -5" || break
+done
+commit_stage p100m logs/p100m_step.jsonl
+
+date -u +"%Y-%m-%dT%H:%M:%SZ r4 queue done"
