@@ -148,7 +148,6 @@ def main(cfg: Config):
                 restored["ema"] = ema_init(restored["params"])
             elif ema is None:
                 restored.pop("ema", None)
-        if restored:
             params, opt_state, step_idx = (
                 restored["params"],
                 restored["opt_state"],

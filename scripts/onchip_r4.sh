@@ -16,7 +16,12 @@ float(jnp.ones((8,128)).sum())" >/dev/null 2>&1; }
 
 commit_stage() {  # commit_stage NAME FILES...
   name=$1; shift
-  git add -f "$@" logs/onchip_r4.log 2>/dev/null
+  # add one file per invocation, existing files only: a single git add
+  # with a missing pathspec stages NOTHING, which would lose every
+  # artifact of a partially-completed stage
+  for f in "$@" logs/onchip_r4.log; do
+    [ -e "$f" ] && git add -f "$f"
+  done
   git commit -q -m "On-chip r4 queue: $name artifacts
 
 No-Verification-Needed: measurement logs only" || true
