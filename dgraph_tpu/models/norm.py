@@ -18,10 +18,21 @@ import jax.numpy as jnp
 
 
 class DistributedBatchNorm(nn.Module):
+    """``recompute=True`` is the reference's DistributedBN_with_Recompute
+    (``distributed_layers.py:77-107``): the backward saves only the raw
+    input plus the [F]-sized stats and REMATERIALIZES the normalized
+    tensor, instead of keeping the [n_pad, F] x_hat residual alive
+    through the whole backward. Here that is ``jax.checkpoint`` with a
+    nothing-saved policy around the pure-local normalization — the stats
+    collectives stay OUTSIDE the remat region (like the reference, which
+    reuses forward's mean/var in backward), so recompute adds zero extra
+    communication."""
+
     comm: Any
     momentum: float = 0.9
     epsilon: float = 1e-5
     use_running_average: bool = False
+    recompute: bool = False
 
     @nn.compact
     def __call__(
@@ -55,4 +66,16 @@ class DistributedBatchNorm(nn.Module):
             if not self.is_initializing():
                 ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mean
                 ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
-        return scale * (x - mean) * jax.lax.rsqrt(var + self.epsilon) + bias
+
+        eps = self.epsilon
+
+        def _normalize(x, mean, var, scale, bias):
+            return scale * (x - mean) * jax.lax.rsqrt(var + eps) + bias
+
+        if self.recompute:
+            # save NOTHING from inside the region: backward recomputes the
+            # normalization from (x, mean, var, scale, bias), all of which
+            # the surrounding graph already keeps
+            _normalize = jax.checkpoint(
+                _normalize, policy=jax.checkpoint_policies.nothing_saveable)
+        return _normalize(x, mean, var, scale, bias)

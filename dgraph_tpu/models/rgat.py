@@ -67,6 +67,7 @@ class RGATLayer(nn.Module):
     relations: Sequence[tuple]  # RelKeys
     num_heads: int = 2
     use_batch_norm: bool = True
+    bn_recompute: bool = False  # reference's DistributedBN_with_Recompute
     dtype: Any = None
 
     @nn.compact
@@ -92,9 +93,10 @@ class RGATLayer(nn.Module):
         for t, h in agg.items():
             h = nn.relu(h)
             if self.use_batch_norm:
-                h = DistributedBatchNorm(comm=self.comm, name=f"bn_{t}")(
-                    h, vertex_masks[t], use_running_average=not train
-                )
+                h = DistributedBatchNorm(
+                    comm=self.comm, recompute=self.bn_recompute,
+                    name=f"bn_{t}",
+                )(h, vertex_masks[t], use_running_average=not train)
             out[t] = h
         return out
 
@@ -111,6 +113,7 @@ class RGAT(nn.Module):
     num_layers: int = 2
     num_heads: int = 2
     use_batch_norm: bool = True
+    bn_recompute: bool = False
     dtype: Any = None
 
     @nn.compact
@@ -125,6 +128,7 @@ class RGAT(nn.Module):
                 relations=tuple(self.relations),
                 num_heads=self.num_heads,
                 use_batch_norm=self.use_batch_norm,
+                bn_recompute=self.bn_recompute,
                 dtype=self.dtype,
                 name=f"layer_{i}",
             )(h, plans, vertex_masks, train)

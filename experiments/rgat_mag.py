@@ -29,6 +29,7 @@ class Config:
     num_layers: int = 2
     num_heads: int = 2
     batch_norm: bool = True
+    bn_recompute: bool = False  # remat the BN normalization in backward
     lr: float = 3e-3
     epochs: int = 60
     world_size: int = 0
@@ -96,6 +97,7 @@ def main(cfg: Config):
         num_layers=cfg.num_layers,
         num_heads=cfg.num_heads,
         use_batch_norm=cfg.batch_norm,
+        bn_recompute=cfg.bn_recompute,
     )
 
     feats = {t: jnp.asarray(v) for t, v in g.features.items()}
