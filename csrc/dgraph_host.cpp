@@ -15,6 +15,7 @@
 #include <algorithm>
 #include <atomic>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <queue>
 #include <random>
@@ -313,6 +314,128 @@ void refine(const WGraph& g, int32_t world_size, std::vector<int32_t>& part,
   }
 }
 
+// Proper FM (KL/FM-class) k-way refinement with hill climbing: moves are
+// taken in gain order from a lazy max-heap, each vertex moves at most once
+// per pass, NEGATIVE-gain moves are allowed, and the pass rolls back to
+// the best cumulative-cut prefix. This escapes the local minima the
+// positive-gain-only refine() above gets stuck in — the difference between
+// "26% better than random" and METIS-class cuts (VERDICT r3 #6).
+//
+// Cost model (the classic FM implementation): a [nv, W] connection table
+// updated incrementally — O(deg) per applied move, O(W) per gain read —
+// instead of recomputing neighbor gains from adjacency (O(deg^2) per move,
+// which power-law hubs turn quadratic). Levels whose table would exceed
+// the memory gate skip FM and keep the greedy refine result.
+void fm_refine(const WGraph& g, int32_t world_size, std::vector<int32_t>& part,
+               int passes, double imbalance) {
+  const char* env = std::getenv("DGRAPH_HOST_FM");
+  if (env && env[0] == '0') return;  // A/B kill switch (greedy-only result)
+  const int64_t table_bytes = g.nv * int64_t(world_size) * 8;
+  if (table_bytes > (int64_t(6) << 30)) return;  // memory gate (papers100M finest level at high W)
+  int64_t total_vw = 0;
+  for (auto w : g.vw) total_vw += w;
+  const int64_t cap =
+      static_cast<int64_t>((double(total_vw) / world_size) * imbalance) + 1;
+  std::vector<int64_t> pw(world_size, 0);
+  for (int64_t v = 0; v < g.nv; ++v) pw[part[v]] += g.vw[v];
+  const int32_t W = world_size;
+  // conn[v*W + r] = total edge weight from v into partition r; maintained
+  // incrementally across passes AND across rollbacks (apply/revert are the
+  // same table update with roles swapped)
+  std::vector<int64_t> conn(size_t(g.nv) * W, 0);
+  for (int64_t v = 0; v < g.nv; ++v)
+    for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k)
+      conn[size_t(v) * W + part[g.adj[k]]] += g.ew[k];
+  std::vector<uint8_t> locked(g.nv, 0);
+  std::vector<int64_t> cur_gain(g.nv, INT64_MIN);
+
+  // best balance-feasible move for v from its conn row; INT64_MIN when
+  // interior or nothing feasible
+  auto best_from_row = [&](int64_t v, int32_t* out_r) -> int64_t {
+    const int32_t pv = part[v];
+    const int64_t* row = conn.data() + size_t(v) * W;
+    int32_t best = pv;
+    int64_t best_gain = INT64_MIN;
+    for (int32_t r = 0; r < W; ++r) {
+      if (r == pv || (row[r] == 0 && best_gain != INT64_MIN)) continue;
+      if (pw[r] + g.vw[v] > cap) continue;
+      const int64_t gain = row[r] - row[pv];
+      if (gain > best_gain) { best = r; best_gain = gain; }
+    }
+    // interior vertices (no edge into any other part) are not worth
+    // queueing: their best gain is -row[pv], a pure-loss move
+    bool boundary = false;
+    for (int32_t r = 0; r < W; ++r)
+      if (r != pv && row[r] > 0) { boundary = true; break; }
+    if (!boundary || best == pv) { *out_r = pv; return INT64_MIN; }
+    *out_r = best;
+    return best_gain;
+  };
+
+  // move v from pv to tgt, updating part/pw/conn rows of neighbors
+  auto apply_move = [&](int64_t v, int32_t pv, int32_t tgt) {
+    pw[pv] -= g.vw[v];
+    pw[tgt] += g.vw[v];
+    part[v] = tgt;
+    for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k) {
+      int64_t* row = conn.data() + size_t(g.adj[k]) * W;
+      row[pv] -= g.ew[k];
+      row[tgt] += g.ew[k];
+    }
+  };
+
+  struct Move { int64_t v; int32_t from, to; };
+  std::vector<Move> trail;
+  std::priority_queue<std::pair<int64_t, int64_t>> heap;  // (gain, v)
+
+  for (int p = 0; p < passes; ++p) {
+    std::fill(locked.begin(), locked.end(), 0);
+    std::fill(cur_gain.begin(), cur_gain.end(), INT64_MIN);
+    while (!heap.empty()) heap.pop();
+    for (int64_t v = 0; v < g.nv; ++v) {
+      int32_t tgt;
+      const int64_t gain = best_from_row(v, &tgt);
+      if (gain != INT64_MIN) { cur_gain[v] = gain; heap.emplace(gain, v); }
+    }
+    trail.clear();
+    int64_t cum = 0, best_cum = 0;
+    size_t best_len = 0;
+    while (!heap.empty()) {
+      auto [gain, v] = heap.top();
+      heap.pop();
+      if (locked[v] || gain != cur_gain[v]) continue;  // stale entry
+      int32_t tgt;
+      const int64_t now = best_from_row(v, &tgt);  // pw may have shifted
+      if (now == INT64_MIN) { cur_gain[v] = INT64_MIN; continue; }
+      if (now != gain) { cur_gain[v] = now; heap.emplace(now, v); continue; }
+      const int32_t pv = part[v];
+      apply_move(v, pv, tgt);
+      locked[v] = 1;
+      trail.push_back({v, pv, tgt});
+      cum += now;
+      if (cum > best_cum) { best_cum = cum; best_len = trail.size(); }
+      // neighbors' rows changed by apply_move; refresh their queue keys
+      for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k) {
+        const int64_t n = g.adj[k];
+        if (locked[n]) continue;
+        int32_t ntgt;
+        const int64_t ngain = best_from_row(n, &ntgt);
+        if (ngain != cur_gain[n]) {
+          cur_gain[n] = ngain;
+          if (ngain != INT64_MIN) heap.emplace(ngain, n);
+        }
+      }
+    }
+    // roll back to the best prefix (classic FM: the tail of the pass was
+    // exploration that didn't pay off)
+    for (size_t i = trail.size(); i > best_len; --i) {
+      const Move& m = trail[i - 1];
+      apply_move(m.v, m.to, m.from);
+    }
+    if (best_cum <= 0) break;  // pass found no net improvement
+  }
+}
+
 }  // namespace
 
 // Multilevel k-way partition (the METIS-shaped algorithm the reference
@@ -341,13 +464,19 @@ void multilevel_partition(const int64_t* src, const int64_t* dst,
   }
   std::vector<int32_t> part;
   initial_partition(levels.back(), world_size, rng, part);
+  // cheap greedy warmup, then hill-climbing FM (rollback makes the
+  // negative-gain exploration safe at every level)
   refine(levels.back(), world_size, part, /*passes=*/4, /*imbalance=*/1.03);
+  fm_refine(levels.back(), world_size, part, /*passes=*/6, /*imbalance=*/1.03);
   for (int64_t l = static_cast<int64_t>(cmaps.size()) - 1; l >= 0; --l) {
     const std::vector<int64_t>& cmap = cmaps[l];
     std::vector<int32_t> fine(levels[l].nv);
     for (int64_t v = 0; v < levels[l].nv; ++v) fine[v] = part[cmap[v]];
     part = std::move(fine);
+    // greedy passes stay at the r3 value so DGRAPH_HOST_FM=0 reproduces
+    // the pre-FM partitioner exactly (the A/B must isolate fm_refine)
     refine(levels[l], world_size, part, /*passes=*/2, /*imbalance=*/1.03);
+    fm_refine(levels[l], world_size, part, /*passes=*/3, /*imbalance=*/1.03);
   }
   std::memcpy(out_part, part.data(), num_vertices * sizeof(int32_t));
 }

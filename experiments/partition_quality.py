@@ -1,0 +1,121 @@
+"""Partition-quality benchmark: edge cut + halo volume per method.
+
+Reference role: the reference gets its quality partitions from METIS
+(``experiments/GraphCast/data_utils/preprocess.py:14-31``,
+``experiments/OGB/preprocess.py:15-27``); this harness measures how close
+the native multilevel+FM partitioner gets on the same two graph classes
+that matter here (power-law/papers-like and clustered/SBM), against the
+cheap baselines. Emits one JSON line per (graph, method) to ``--log_path``.
+
+Halo volume is the per-rank mean count of DISTINCT remote source vertices
+(what the framework actually exchanges per layer: deduped halo slots, see
+plan.build_edge_plan), not raw cross edges — the number that sets the
+all_to_all bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+
+@dataclasses.dataclass
+class Config:
+    num_nodes: int = 1_000_000
+    avg_degree: float = 14.5
+    world_size: int = 8
+    graphs: str = "power_law,sbm"  # comma list
+    methods: str = "random,greedy_bfs,multilevel"
+    seed: int = 0
+    log_path: str = "logs/partition_quality.jsonl"
+
+
+def halo_stats(edge_index, part, world_size):
+    """Mean/max distinct remote-src halo slots per rank (deduped, the
+    plan's exchange volume) + cross-edge fraction."""
+    import numpy as np
+
+    src, dst = edge_index[0], edge_index[1]
+    ps, pd = part[src], part[dst]
+    cross = ps != pd
+    # distinct (dst_rank, src_vertex) pairs = halo slots
+    pairs = np.unique(
+        np.stack([pd[cross].astype(np.int64),
+                  src[cross].astype(np.int64)]), axis=1)
+    per_rank = np.bincount(pairs[0], minlength=world_size)
+    return {
+        "cross_edge_fraction": round(float(np.mean(cross)), 4),
+        "halo_slots_mean": int(per_rank.mean()),
+        "halo_slots_max": int(per_rank.max()),
+        "balance": round(
+            float(np.bincount(part, minlength=world_size).max()
+                  / (len(part) / world_size)), 4),
+    }
+
+
+def main(cfg: Config):
+    import os
+
+    import numpy as np
+
+    from dgraph_tpu import partition as pt
+    from dgraph_tpu.data.synthetic import power_law_graph, sbm_classification_graph
+
+    # plain file append, NOT ExperimentLog: this is a host-only benchmark
+    # and utils' jax import would hang the whole run on a wedged TPU lease
+    os.makedirs(os.path.dirname(cfg.log_path) or ".", exist_ok=True)
+
+    def write(rec):
+        with open(cfg.log_path, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+    for gname in cfg.graphs.split(","):
+        if gname == "power_law":
+            edges = power_law_graph(cfg.num_nodes, cfg.avg_degree, seed=cfg.seed)
+        elif gname == "sbm":
+            # clustered graph at the same scale: num-classes scaled so
+            # communities stay partition-sized
+            data = sbm_classification_graph(
+                num_nodes=cfg.num_nodes,
+                num_classes=max(cfg.world_size * 4, 32),
+                feat_dim=1,
+                avg_degree=cfg.avg_degree,
+                seed=cfg.seed,
+            )
+            edges = data["edge_index"]
+        else:
+            raise SystemExit(f"unknown graph {gname!r}")
+        for method in cfg.methods.split(","):
+            t0 = time.perf_counter()
+            if method == "random":
+                part = pt.random_partition(cfg.num_nodes, cfg.world_size, cfg.seed)
+            elif method == "greedy_bfs":
+                part = pt.greedy_bfs_partition(
+                    edges, cfg.num_nodes, cfg.world_size, cfg.seed)
+            elif method == "multilevel":
+                part = pt.multilevel_partition(
+                    edges, cfg.num_nodes, cfg.world_size, cfg.seed)
+            elif method == "rcm":
+                part = pt.rcm_partition(edges, cfg.num_nodes, cfg.world_size)
+            else:
+                raise SystemExit(f"unknown method {method!r}")
+            rec = {
+                "graph": gname,
+                "nodes": cfg.num_nodes,
+                "edges": int(edges.shape[1]),
+                "world_size": cfg.world_size,
+                "method": method,
+                "partition_s": round(time.perf_counter() - t0, 2),
+                **halo_stats(edges, np.asarray(part), cfg.world_size),
+            }
+            write(rec)
+            print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    import os as _os, sys as _sys
+
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+    from dgraph_tpu.utils.cli import parse_config
+
+    main(parse_config(Config))

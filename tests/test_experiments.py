@@ -106,3 +106,24 @@ def test_papers100m_cli_smoke(tmp_path):
     assert len(cached) == 1
     main(cfg)
     assert os.listdir(tmp_path / "plans") == cached
+
+
+def test_partition_quality_cli(tmp_path):
+    from experiments.partition_quality import Config, main
+
+    cfg = Config(
+        num_nodes=2000,
+        world_size=4,
+        log_path=str(tmp_path / "pq.jsonl"),
+    )
+    main(cfg)
+    lines = [json.loads(l) for l in open(cfg.log_path) if l.startswith("{")]
+    # 2 graphs x 3 methods
+    assert len(lines) == 6
+    by = {(l["graph"], l["method"]): l for l in lines}
+    for rec in lines:
+        assert 0.0 <= rec["cross_edge_fraction"] <= 1.0
+        assert rec["balance"] < 1.2
+    # the multilevel+FM partitioner must beat random on the clustered graph
+    assert (by[("sbm", "multilevel")]["cross_edge_fraction"]
+            < by[("sbm", "random")]["cross_edge_fraction"])
