@@ -48,8 +48,13 @@ commit_stage bench_gcn logs/bench_r4_gcn.json logs/bench_r4_gcn.err
 
 # 2. Kernel tile sweep (VERDICT r3 #2: settle both gather defaults on the
 #    fixed timing harness; low memory risk).
-run_stage sweep bash -c 'set -o pipefail; timeout 2400 python experiments/kernel_benchmarks.py --sweep true --dtypes float32,bfloat16 2>&1 | tail -30'
-commit_stage sweep logs/kernel_benchmarks.jsonl
+if run_stage sweep bash -c 'set -o pipefail; timeout 2400 python experiments/kernel_benchmarks.py --sweep true --dtypes float32,bfloat16 2>&1 | tail -30'; then
+  # winners ONLY from a completed r4 sweep — a skipped/killed stage would
+  # leave stale r3 rows (broken timing harness) and the analysis would
+  # silently bless them
+  python scripts/adopt_sweep.py logs/kernel_benchmarks.jsonl > logs/sweep_winners.txt 2>&1 || true
+fi
+commit_stage sweep logs/kernel_benchmarks.jsonl logs/sweep_winners.txt
 
 # 3. Gather-kernel A/B: GCN bench with the sorted-row-gather kernel
 #    pinned on (self-check-vetoed). Compare value vs logs/bench_r4_gcn.json.
