@@ -55,7 +55,9 @@ def main(path: str = "logs/kernel_benchmarks.jsonl") -> None:
     # the bf16-MXU "default" variant for f32 would judge a kernel that
     # never runs in f32 training)
     def deployed_scatter_op(dtype):
-        return ("segment_sum_pallas_default" if dtype == "bfloat16"
+        # kernel_benchmarks logs dtype as "bf16"/"f32"
+        is_bf16 = dtype in ("bf16", "bfloat16")
+        return ("segment_sum_pallas_default" if is_bf16
                 else "segment_sum_pallas_highest")
 
     print("\n== XLA vs Pallas verdicts (deployed precision per dtype) ==")
@@ -97,11 +99,10 @@ def main(path: str = "logs/kernel_benchmarks.jsonl") -> None:
             return None
 
         votes = defaultdict(int)
-        for (op, dtype, F), tiles in sweep.items():
-            fam = family(op, dtype)
-            if fam is None:
+        for (op, dtype, F), best in winners.items():
+            if family(op, dtype) is None:
                 continue
-            votes[min(tiles, key=tiles.get)] += 1
+            votes[best] += 1
         if votes:
             (be, bn), n = max(votes.items(), key=lambda kv: kv[1])
             print(f"\n== consensus: block_e={be} block_n={bn} "
