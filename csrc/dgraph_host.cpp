@@ -330,8 +330,18 @@ void fm_refine(const WGraph& g, int32_t world_size, std::vector<int32_t>& part,
                int passes, double imbalance) {
   const char* env = std::getenv("DGRAPH_HOST_FM");
   if (env && env[0] == '0') return;  // A/B kill switch (greedy-only result)
+  // memory gate: default 6 GB skips the papers100M finest level at W=8
+  // (7.1 GB table); hosts with the RAM to spare can raise it via
+  // DGRAPH_HOST_FM_TABLE_GB (FM always runs on the coarser levels either way)
+  int64_t gate_gb = 6;
+  if (const char* ge = std::getenv("DGRAPH_HOST_FM_TABLE_GB")) {
+    const int64_t v = std::atoll(ge);
+    // clamp before the <<30: a huge/wrong-unit value would overflow the
+    // shift (UB -> negative) and silently DISABLE FM everywhere
+    if (v > 0) gate_gb = std::min<int64_t>(v, int64_t(1) << 20);
+  }
   const int64_t table_bytes = g.nv * int64_t(world_size) * 8;
-  if (table_bytes > (int64_t(6) << 30)) return;  // memory gate (papers100M finest level at high W)
+  if (table_bytes > (gate_gb << 30)) return;
   int64_t total_vw = 0;
   for (auto w : g.vw) total_vw += w;
   const int64_t cap =
