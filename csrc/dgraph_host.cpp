@@ -335,9 +335,10 @@ void fm_refine(const WGraph& g, int32_t world_size, std::vector<int32_t>& part,
   // DGRAPH_HOST_FM_TABLE_GB (FM always runs on the coarser levels either way)
   int64_t gate_gb = 6;
   if (const char* ge = std::getenv("DGRAPH_HOST_FM_TABLE_GB")) {
-    const int64_t v = std::atoll(ge);
-    // clamp before the <<30: a huge/wrong-unit value would overflow the
-    // shift (UB -> negative) and silently DISABLE FM everywhere
+    // strtoll saturates on out-of-range input (atoll is UB there); clamp
+    // before the <<30 so a huge/wrong-unit value can't overflow the shift
+    // (UB -> negative) and silently DISABLE FM everywhere
+    const int64_t v = std::strtoll(ge, nullptr, 10);
     if (v > 0) gate_gb = std::min<int64_t>(v, int64_t(1) << 20);
   }
   const int64_t table_bytes = g.nv * int64_t(world_size) * 8;
@@ -410,7 +411,15 @@ void fm_refine(const WGraph& g, int32_t world_size, std::vector<int32_t>& part,
     trail.clear();
     int64_t cum = 0, best_cum = 0;
     size_t best_len = 0;
+    // stall cap (the classic FM early-out): once this many moves have
+    // accumulated past the best prefix without improving it, the pass's
+    // tail is already guaranteed rollback work — on power-law graphs the
+    // uncapped tail is ~nv moves and dominates runtime while contributing
+    // exactly nothing
+    const size_t stall_cap =
+        std::max<size_t>(1024, static_cast<size_t>(g.nv / 64));
     while (!heap.empty()) {
+      if (trail.size() - best_len > stall_cap) break;
       auto [gain, v] = heap.top();
       heap.pop();
       if (locked[v] || gain != cur_gain[v]) continue;  // stale entry
