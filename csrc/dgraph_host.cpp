@@ -314,6 +314,35 @@ void refine(const WGraph& g, int32_t world_size, std::vector<int32_t>& part,
   }
 }
 
+// Shared setup for the table-based refiners: env-tunable memory gate for
+// the [nv, W] connection table, balance cap, per-rank weights, and the
+// table itself (conn[v*W + r] = edge weight from v into rank r).
+// Returns false when the table would exceed the gate (strtoll saturates
+// on out-of-range input — atoll is UB there; the clamp keeps <<30 from
+// overflowing into a negative gate that would silently disable the
+// refiner everywhere).
+bool build_conn_table(const WGraph& g, int32_t W,
+                      const std::vector<int32_t>& part, double imbalance,
+                      int64_t* cap_out, std::vector<int64_t>& pw,
+                      std::vector<int64_t>& conn) {
+  int64_t gate_gb = 6;
+  if (const char* ge = std::getenv("DGRAPH_HOST_FM_TABLE_GB")) {
+    const int64_t v = std::strtoll(ge, nullptr, 10);
+    if (v > 0) gate_gb = std::min<int64_t>(v, int64_t(1) << 20);
+  }
+  if (g.nv * int64_t(W) * 8 > (gate_gb << 30)) return false;
+  int64_t total_vw = 0;
+  for (auto w : g.vw) total_vw += w;
+  *cap_out = static_cast<int64_t>((double(total_vw) / W) * imbalance) + 1;
+  pw.assign(W, 0);
+  for (int64_t v = 0; v < g.nv; ++v) pw[part[v]] += g.vw[v];
+  conn.assign(size_t(g.nv) * W, 0);
+  for (int64_t v = 0; v < g.nv; ++v)
+    for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k)
+      conn[size_t(v) * W + part[g.adj[k]]] += g.ew[k];
+  return true;
+}
+
 // Proper FM (KL/FM-class) k-way refinement with hill climbing: moves are
 // taken in gain order from a lazy max-heap, each vertex moves at most once
 // per pass, NEGATIVE-gain moves are allowed, and the pass rolls back to
@@ -330,33 +359,14 @@ void fm_refine(const WGraph& g, int32_t world_size, std::vector<int32_t>& part,
                int passes, double imbalance) {
   const char* env = std::getenv("DGRAPH_HOST_FM");
   if (env && env[0] == '0') return;  // A/B kill switch (greedy-only result)
-  // memory gate: default 6 GB skips the papers100M finest level at W=8
-  // (7.1 GB table); hosts with the RAM to spare can raise it via
-  // DGRAPH_HOST_FM_TABLE_GB (FM always runs on the coarser levels either way)
-  int64_t gate_gb = 6;
-  if (const char* ge = std::getenv("DGRAPH_HOST_FM_TABLE_GB")) {
-    // strtoll saturates on out-of-range input (atoll is UB there); clamp
-    // before the <<30 so a huge/wrong-unit value can't overflow the shift
-    // (UB -> negative) and silently DISABLE FM everywhere
-    const int64_t v = std::strtoll(ge, nullptr, 10);
-    if (v > 0) gate_gb = std::min<int64_t>(v, int64_t(1) << 20);
-  }
-  const int64_t table_bytes = g.nv * int64_t(world_size) * 8;
-  if (table_bytes > (gate_gb << 30)) return;
-  int64_t total_vw = 0;
-  for (auto w : g.vw) total_vw += w;
-  const int64_t cap =
-      static_cast<int64_t>((double(total_vw) / world_size) * imbalance) + 1;
-  std::vector<int64_t> pw(world_size, 0);
-  for (int64_t v = 0; v < g.nv; ++v) pw[part[v]] += g.vw[v];
   const int32_t W = world_size;
-  // conn[v*W + r] = total edge weight from v into partition r; maintained
-  // incrementally across passes AND across rollbacks (apply/revert are the
-  // same table update with roles swapped)
-  std::vector<int64_t> conn(size_t(g.nv) * W, 0);
-  for (int64_t v = 0; v < g.nv; ++v)
-    for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k)
-      conn[size_t(v) * W + part[g.adj[k]]] += g.ew[k];
+  // gate default 6 GB skips the papers100M finest level at W=8 (7.1 GB
+  // table); FM always runs on the coarser levels either way. The conn
+  // table is maintained incrementally across passes AND across rollbacks
+  // (apply/revert are the same table update with roles swapped).
+  int64_t cap;
+  std::vector<int64_t> pw, conn;
+  if (!build_conn_table(g, W, part, imbalance, &cap, pw, conn)) return;
   std::vector<uint8_t> locked(g.nv, 0);
   std::vector<int64_t> cur_gain(g.nv, INT64_MIN);
 
@@ -455,6 +465,80 @@ void fm_refine(const WGraph& g, int32_t world_size, std::vector<int32_t>& part,
   }
 }
 
+// Communication-VOLUME polish: greedy positive-gain passes on the deduped
+// halo-slot objective — the number of distinct (needing-rank, vertex)
+// pairs, which is what actually sizes the halo all_to_all. FM above
+// minimizes raw edge cut; on hub-heavy graphs the two diverge (a hub with
+// 50 edges into rank r is 50 cut edges but ONE halo slot), so a final
+// polish on the true wire metric recovers bytes the cut objective can't
+// see. Gain of moving v from pv to tgt:
+//   Δslots = [v needed by tgt before]        (that need disappears)
+//          - [v needed by pv after]          (a new need appears)
+//          + Σ_u∈N(v) ( [v was u's only pv-edge && owner(u)!=pv]
+//                     - [u had no tgt-edge   && owner(u)!=tgt] )
+// computed exactly from the same incremental [nv, W] connection table.
+void volume_polish(const WGraph& g, int32_t world_size,
+                   std::vector<int32_t>& part, int passes, double imbalance) {
+  const char* env = std::getenv("DGRAPH_HOST_VOLUME_POLISH");
+  if (env && env[0] == '0') return;  // A/B kill switch
+  const char* fm_env = std::getenv("DGRAPH_HOST_FM");
+  if (fm_env && fm_env[0] == '0') return;  // DGRAPH_HOST_FM=0 must yield
+  // the documented greedy-only baseline — polish counts as refinement
+  const int32_t W = world_size;
+  int64_t cap;
+  std::vector<int64_t> pw, conn;
+  if (!build_conn_table(g, W, part, imbalance, &cap, pw, conn)) return;
+
+  for (int p = 0; p < passes; ++p) {
+    int64_t moves = 0;
+    for (int64_t v = 0; v < g.nv; ++v) {
+      const int32_t pv = part[v];
+      const int64_t* row = conn.data() + size_t(v) * W;
+      // candidate targets: ranks v already has edges into (moving toward
+      // a rank with no edges can never reduce slots)
+      int32_t best = pv;
+      int64_t best_gain = 0, best_cut = 0;
+      // the pv-side terms are target-independent: hoist them out of the
+      // candidate loop (they're half the dominant inner-loop cost)
+      int64_t pv_gain = row[pv] > 0 ? 0 : 1;  // tgt's need for v always
+      // disappears (+1); pv starts needing v unless v has no pv edge
+      for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k) {
+        const int64_t u = g.adj[k];
+        if (conn[size_t(u) * W + pv] == g.ew[k] && part[u] != pv)
+          pv_gain += 1;  // u stops being needed by pv (its only pv edge)
+      }
+      for (int32_t tgt = 0; tgt < W; ++tgt) {
+        if (tgt == pv || row[tgt] == 0 || pw[tgt] + g.vw[v] > cap) continue;
+        int64_t gain = pv_gain;
+        for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k) {
+          const int64_t u = g.adj[k];
+          if (conn[size_t(u) * W + tgt] == 0 && part[u] != tgt)
+            gain -= 1;  // u becomes needed by tgt
+        }
+        const int64_t cut_gain = row[tgt] - row[pv];
+        if (gain > best_gain ||
+            (gain == best_gain && gain > 0 && cut_gain > best_cut)) {
+          best = tgt;
+          best_gain = gain;
+          best_cut = cut_gain;
+        }
+      }
+      if (best != pv && best_gain > 0) {
+        pw[pv] -= g.vw[v];
+        pw[best] += g.vw[v];
+        part[v] = best;
+        for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k) {
+          int64_t* urow = conn.data() + size_t(g.adj[k]) * W;
+          urow[pv] -= g.ew[k];
+          urow[best] += g.ew[k];
+        }
+        ++moves;
+      }
+    }
+    if (!moves) break;
+  }
+}
+
 }  // namespace
 
 // Multilevel k-way partition (the METIS-shaped algorithm the reference
@@ -497,6 +581,10 @@ void multilevel_partition(const int64_t* src, const int64_t* dst,
     refine(levels[l], world_size, part, /*passes=*/2, /*imbalance=*/1.03);
     fm_refine(levels[l], world_size, part, /*passes=*/3, /*imbalance=*/1.03);
   }
+  // final polish on the deduped halo-slot objective (finest level only:
+  // that's the graph whose slots ride the wire)
+  volume_polish(levels[0], world_size, part, /*passes=*/4,
+                /*imbalance=*/1.03);
   std::memcpy(out_part, part.data(), num_vertices * sizeof(int32_t));
 }
 

@@ -33,17 +33,28 @@ class Config:
 
 def halo_stats(edge_index, part, world_size):
     """Mean/max distinct remote-src halo slots per rank (deduped, the
-    plan's exchange volume) + cross-edge fraction."""
+    plan's exchange volume) + cross-edge fraction.
+
+    Edges are SYMMETRIZED first: the training pipelines run undirected
+    message passing (both directions materialized — see bench.py /
+    ogb_gcn), so a faithful wire-volume count must include the reverse
+    needs too. Measuring the raw directed list understates hub dedup and
+    can even move opposite to the real exchange volume."""
     import numpy as np
 
     src, dst = edge_index[0], edge_index[1]
     ps, pd = part[src], part[dst]
     cross = ps != pd
-    # distinct (dst_rank, src_vertex) pairs = halo slots
-    pairs = np.unique(
-        np.stack([pd[cross].astype(np.int64),
-                  src[cross].astype(np.int64)]), axis=1)
-    per_rank = np.bincount(pairs[0], minlength=world_size)
+    # distinct (needing_rank, needed_vertex) pairs = halo slots. Dedup each
+    # direction separately, then union: peak memory stays ~1x the cross
+    # edges instead of materializing the full symmetrized list (4x for
+    # generators that already emit both directions).
+    fwd = np.unique(pd[cross].astype(np.int64) * len(part)
+                    + src[cross].astype(np.int64))
+    rev = np.unique(ps[cross].astype(np.int64) * len(part)
+                    + dst[cross].astype(np.int64))
+    slots = np.union1d(fwd, rev)
+    per_rank = np.bincount(slots // len(part), minlength=world_size)
     return {
         "cross_edge_fraction": round(float(np.mean(cross)), 4),
         "halo_slots_mean": int(per_rank.mean()),
