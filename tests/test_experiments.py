@@ -127,3 +127,59 @@ def test_partition_quality_cli(tmp_path):
     # the multilevel+FM partitioner must beat random on the clustered graph
     assert (by[("sbm", "multilevel")]["cross_edge_fraction"]
             < by[("sbm", "random")]["cross_edge_fraction"])
+
+
+def test_volume_polish_reduces_halo_slots(tmp_path):
+    """The volume polish must not increase deduped halo slots on a
+    clustered graph (its exact objective), and DGRAPH_HOST_FM=0 must
+    reproduce the greedy-only baseline (polish counts as refinement)."""
+    import os
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    from dgraph_tpu import native, partition as pt
+    from dgraph_tpu.data.synthetic import sbm_classification_graph
+    from experiments.partition_quality import halo_stats
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native library not built")
+    data = sbm_classification_graph(
+        num_nodes=6000, num_classes=16, feat_dim=1, avg_degree=12.0, seed=3
+    )
+    edges = data["edge_index"]
+
+    def run(env):
+        e = dict(os.environ, **env)
+        # subprocess: the env gates are read inside the native call, and
+        # the test must not leak env mutations into this process
+        out = subprocess.run(
+            [sys.executable, "-c", (
+                "import numpy as np, sys\n"
+                "from dgraph_tpu import partition as pt\n"
+                "edges = np.load(sys.argv[1])\n"
+                "p = pt.multilevel_partition(edges, 6000, 4, 0)\n"
+                "np.save(sys.argv[2], p)\n"
+            ), str(tmp_path / "edges.npy"), str(tmp_path / "part.npy")],
+            env=e, capture_output=True, text=True, cwd=str(REPO),
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        return np.load(tmp_path / "part.npy")
+
+    np.save(tmp_path / "edges.npy", edges)
+    part_full = run({})
+    part_nopolish = run({"DGRAPH_HOST_VOLUME_POLISH": "0"})
+    s_full = halo_stats(edges, part_full, 4)
+    s_nopol = halo_stats(edges, part_nopolish, 4)
+    mean_full = s_full["halo_slots_mean"]
+    mean_nopol = s_nopol["halo_slots_mean"]
+    assert mean_full <= mean_nopol, (s_full, s_nopol)
+
+    # FM=0 baseline: polish must NOT run (identical to FM=0 + polish=0)
+    part_fm0 = run({"DGRAPH_HOST_FM": "0"})
+    part_fm0_p0 = run({"DGRAPH_HOST_FM": "0",
+                       "DGRAPH_HOST_VOLUME_POLISH": "0"})
+    assert np.array_equal(part_fm0, part_fm0_p0)
