@@ -355,18 +355,9 @@ bool build_conn_table(const WGraph& g, int32_t W,
 // instead of recomputing neighbor gains from adjacency (O(deg^2) per move,
 // which power-law hubs turn quadratic). Levels whose table would exceed
 // the memory gate skip FM and keep the greedy refine result.
-void fm_refine(const WGraph& g, int32_t world_size, std::vector<int32_t>& part,
-               int passes, double imbalance) {
-  const char* env = std::getenv("DGRAPH_HOST_FM");
-  if (env && env[0] == '0') return;  // A/B kill switch (greedy-only result)
-  const int32_t W = world_size;
-  // gate default 6 GB skips the papers100M finest level at W=8 (7.1 GB
-  // table); FM always runs on the coarser levels either way. The conn
-  // table is maintained incrementally across passes AND across rollbacks
-  // (apply/revert are the same table update with roles swapped).
-  int64_t cap;
-  std::vector<int64_t> pw, conn;
-  if (!build_conn_table(g, W, part, imbalance, &cap, pw, conn)) return;
+void fm_refine_impl(const WGraph& g, int32_t W, std::vector<int32_t>& part,
+                    int passes, int64_t cap, std::vector<int64_t>& pw,
+                    std::vector<int64_t>& conn) {
   std::vector<uint8_t> locked(g.nv, 0);
   std::vector<int64_t> cur_gain(g.nv, INT64_MIN);
 
@@ -477,17 +468,10 @@ void fm_refine(const WGraph& g, int32_t world_size, std::vector<int32_t>& part,
 //          + Σ_u∈N(v) ( [v was u's only pv-edge && owner(u)!=pv]
 //                     - [u had no tgt-edge   && owner(u)!=tgt] )
 // computed exactly from the same incremental [nv, W] connection table.
-void volume_polish(const WGraph& g, int32_t world_size,
-                   std::vector<int32_t>& part, int passes, double imbalance) {
-  const char* env = std::getenv("DGRAPH_HOST_VOLUME_POLISH");
-  if (env && env[0] == '0') return;  // A/B kill switch
-  const char* fm_env = std::getenv("DGRAPH_HOST_FM");
-  if (fm_env && fm_env[0] == '0') return;  // DGRAPH_HOST_FM=0 must yield
-  // the documented greedy-only baseline — polish counts as refinement
-  const int32_t W = world_size;
-  int64_t cap;
-  std::vector<int64_t> pw, conn;
-  if (!build_conn_table(g, W, part, imbalance, &cap, pw, conn)) return;
+void volume_polish_impl(const WGraph& g, int32_t W,
+                        std::vector<int32_t>& part, int passes, int64_t cap,
+                        std::vector<int64_t>& pw,
+                        std::vector<int64_t>& conn) {
 
   for (int p = 0; p < passes; ++p) {
     int64_t moves = 0;
@@ -539,6 +523,51 @@ void volume_polish(const WGraph& g, int32_t world_size,
   }
 }
 
+
+// Public wrappers: env kill switches + the shared table build. The conn
+// table is maintained incrementally across passes AND across rollbacks
+// (apply/revert are the same table update with roles swapped), so one
+// build serves FM and the volume polish back-to-back — at the finest
+// level of a papers-fraction graph that's a multi-GB transient and an
+// O(E) scan paid once instead of twice. Gate default 6 GB skips the
+// papers100M finest level at W=8 (7.1 GB table); FM always runs on the
+// coarser levels either way.
+bool fm_enabled() {
+  const char* env = std::getenv("DGRAPH_HOST_FM");
+  return !(env && env[0] == '0');  // '0' = greedy-only A/B baseline
+}
+
+bool polish_enabled() {
+  const char* env = std::getenv("DGRAPH_HOST_VOLUME_POLISH");
+  if (env && env[0] == '0') return false;  // A/B kill switch
+  // DGRAPH_HOST_FM=0 must yield the documented greedy-only baseline —
+  // the polish counts as refinement
+  return fm_enabled();
+}
+
+void fm_refine(const WGraph& g, int32_t world_size, std::vector<int32_t>& part,
+               int passes, double imbalance) {
+  if (!fm_enabled()) return;
+  int64_t cap;
+  std::vector<int64_t> pw, conn;
+  if (!build_conn_table(g, world_size, part, imbalance, &cap, pw, conn))
+    return;
+  fm_refine_impl(g, world_size, part, passes, cap, pw, conn);
+}
+
+void fm_refine_and_polish(const WGraph& g, int32_t world_size,
+                          std::vector<int32_t>& part, int fm_passes,
+                          int polish_passes, double imbalance) {
+  if (!fm_enabled()) return;
+  int64_t cap;
+  std::vector<int64_t> pw, conn;
+  if (!build_conn_table(g, world_size, part, imbalance, &cap, pw, conn))
+    return;
+  fm_refine_impl(g, world_size, part, fm_passes, cap, pw, conn);
+  if (polish_enabled())
+    volume_polish_impl(g, world_size, part, polish_passes, cap, pw, conn);
+}
+
 }  // namespace
 
 // Multilevel k-way partition (the METIS-shaped algorithm the reference
@@ -570,7 +599,15 @@ void multilevel_partition(const int64_t* src, const int64_t* dst,
   // cheap greedy warmup, then hill-climbing FM (rollback makes the
   // negative-gain exploration safe at every level)
   refine(levels.back(), world_size, part, /*passes=*/4, /*imbalance=*/1.03);
-  fm_refine(levels.back(), world_size, part, /*passes=*/6, /*imbalance=*/1.03);
+  if (cmaps.empty()) {
+    // no coarsening happened: the coarsest level IS the finest — run the
+    // combined FM + volume polish here (the uncoarsening loop below won't)
+    fm_refine_and_polish(levels[0], world_size, part, /*fm_passes=*/6,
+                         /*polish_passes=*/4, /*imbalance=*/1.03);
+  } else {
+    fm_refine(levels.back(), world_size, part, /*passes=*/6,
+              /*imbalance=*/1.03);
+  }
   for (int64_t l = static_cast<int64_t>(cmaps.size()) - 1; l >= 0; --l) {
     const std::vector<int64_t>& cmap = cmaps[l];
     std::vector<int32_t> fine(levels[l].nv);
@@ -579,12 +616,17 @@ void multilevel_partition(const int64_t* src, const int64_t* dst,
     // greedy passes stay at the r3 value so DGRAPH_HOST_FM=0 reproduces
     // the pre-FM partitioner exactly (the A/B must isolate fm_refine)
     refine(levels[l], world_size, part, /*passes=*/2, /*imbalance=*/1.03);
-    fm_refine(levels[l], world_size, part, /*passes=*/3, /*imbalance=*/1.03);
-  }
-  // final polish on the deduped halo-slot objective (finest level only:
-  // that's the graph whose slots ride the wire)
-  volume_polish(levels[0], world_size, part, /*passes=*/4,
+    if (l == 0) {
+      // finest level: FM + the halo-slot volume polish share ONE conn
+      // table (the polish targets the metric that actually sizes the
+      // padded all_to_all; only the finest level's slots ride the wire)
+      fm_refine_and_polish(levels[0], world_size, part, /*fm_passes=*/3,
+                           /*polish_passes=*/4, /*imbalance=*/1.03);
+    } else {
+      fm_refine(levels[l], world_size, part, /*passes=*/3,
                 /*imbalance=*/1.03);
+    }
+  }
   std::memcpy(out_part, part.data(), num_vertices * sizeof(int32_t));
 }
 

@@ -282,3 +282,41 @@ def test_flash_shape_gate(monkeypatch):
         assert seq._flash_applicable(jnp.zeros((256, 2, 128))) is False
     finally:
         cfg.set_flags(use_flash_attention=old)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_padded_rows_zero_in_every_impl(causal):
+    """The shared contract (_zero_padded_rows): padded QUERY rows are zero
+    in every implementation, so FULL tensors agree across impls — not just
+    the real-row prefix the other mask tests slice to (flash is TPU-only
+    and carries the same zeroing in _flash_dense)."""
+    from dgraph_tpu.parallel.sequence import ulysses_attention
+
+    mesh = _mesh()
+    H8 = 8
+    rng = np.random.default_rng(11)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((T, H8, D)), jnp.float32)
+        for _ in range(3)
+    )
+    valid = 41
+    kv_mask = (jnp.arange(T) < valid).astype(jnp.float32)
+
+    out_dense = dense_attention(q, k, v, causal=causal, kv_mask=kv_mask)
+    pad = np.asarray(out_dense)[valid:]
+    np.testing.assert_array_equal(pad, np.zeros_like(pad))
+
+    out_ring = ring_attention_sharded(q, k, v, mesh, causal=causal,
+                                      kv_mask=kv_mask)
+    out_uly = shard_map(
+        lambda q_, k_, v_, m_: ulysses_attention(
+            q_, k_, v_, "seq", causal=causal, kv_mask=m_),
+        mesh=mesh,
+        in_specs=(P("seq"), P("seq"), P("seq"), P("seq")),
+        out_specs=P("seq"),
+    )(q, k, v, kv_mask)
+    # FULL tensors, padded rows included
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out_uly), np.asarray(out_dense),
+                               rtol=2e-5, atol=2e-5)
