@@ -300,7 +300,10 @@ def _kernel_bias_relu(
         in_dtype = data_ref.dtype
         chunk = jnp.maximum(chunk.astype(jnp.float32) + bias_rows, 0)
         if has_weight:
-            chunk = chunk * wgt_ref[0, 0][:, None].astype(jnp.float32)
+            # cast BEFORE the [:, None]: Mosaic can only insert a minor dim
+            # on 32-bit vectors (bf16 here fails "Insertion of minor dim
+            # that is not a no-op only supported for 32-bit types")
+            chunk = chunk * wgt_ref[0, 0].astype(jnp.float32)[:, None]
         # back to the input dtype for the contraction (bf16 inputs keep the
         # fast MXU passes; matches the unfused path where m was bf16)
         chunk = chunk.astype(in_dtype)

@@ -43,6 +43,9 @@ class Config:
     sweep: bool = False
     sweep_block_e: str = "512,1024,2048,4096"
     sweep_block_n: str = "256,512"
+    # comma list of op names to skip (resume after a tunnel wedge without
+    # re-dispatching the op that hung; r4: gather_sorted_xla)
+    skip_ops: str = ""
 
 
 def _bench(op, arg, *, reps: int, n_long: int):
@@ -65,13 +68,21 @@ def main(cfg: Config):
     from dgraph_tpu.ops import local as local_ops
     from dgraph_tpu.ops.pallas_segment import max_chunks_hint, sorted_segment_sum
 
-    records = []
+    if cfg.out:
+        os.makedirs(os.path.dirname(cfg.out) or ".", exist_ok=True)
 
     def record(**kw):
         kw["ts"] = time.time()
-        records.append(kw)
-        print(json.dumps(kw))
+        line = json.dumps(kw)
+        print(line)
+        # stream to disk immediately: a tunnel wedge mid-sweep killed the
+        # process in r4 and the buffered write-at-end lost every completed
+        # measurement (only the stdout tail survived)
+        if cfg.out:
+            with open(cfg.out, "a") as f:
+                f.write(line + "\n")
 
+    skipped = {s.strip() for s in cfg.skip_ops.split(",") if s.strip()}
     rng = np.random.default_rng(0)
     V, E = cfg.num_nodes, cfg.num_edges
     N = ((V + 127) // 128) * 128
@@ -93,17 +104,20 @@ def main(cfg: Config):
         ed = jnp.asarray(rng.standard_normal((E_pad, F)), dt)
         bench = partial(_bench, reps=cfg.reps, n_long=cfg.n_long)
 
-        t = bench(lambda a: a[idx], x)
-        record(op="gather_plain", F=F, dtype=dname, ms=round(t, 3),
-               gbps=round(E_pad * F * b / t / 1e6, 1))
-        t = bench(lambda a: local_ops.row_take(a, idx, col_block=128), x)
-        record(op="gather_col_split", F=F, dtype=dname, ms=round(t, 3),
-               gbps=round(E_pad * F * b / t / 1e6, 1))
+        if "gather_plain" not in skipped:
+            t = bench(lambda a: a[idx], x)
+            record(op="gather_plain", F=F, dtype=dname, ms=round(t, 3),
+                   gbps=round(E_pad * F * b / t / 1e6, 1))
+        if "gather_col_split" not in skipped:
+            t = bench(lambda a: local_ops.row_take(a, idx, col_block=128), x)
+            record(op="gather_col_split", F=F, dtype=dname, ms=round(t, 3),
+                   gbps=round(E_pad * F * b / t / 1e6, 1))
         # sorted-id gathers: the owner-side case (XLA vs the Pallas
         # transpose kernel — the A/B that decides use_pallas_gather)
-        t = bench(lambda a: local_ops.row_take(a, sids, col_block=128), x)
-        record(op="gather_sorted_xla", F=F, dtype=dname, ms=round(t, 3),
-               gbps=round(E_pad * F * b / t / 1e6, 1))
+        if "gather_sorted_xla" not in skipped:
+            t = bench(lambda a: local_ops.row_take(a, sids, col_block=128), x)
+            record(op="gather_sorted_xla", F=F, dtype=dname, ms=round(t, 3),
+                   gbps=round(E_pad * F * b / t / 1e6, 1))
         if cfg.pallas and on_tpu:
             from dgraph_tpu.ops.pallas_segment import (
                 max_vblocks_hint,
@@ -113,19 +127,24 @@ def main(cfg: Config):
             mv = max_vblocks_hint(sids_np, N)
             mc0 = max_chunks_hint(sids_np, N)
             prec0 = "default" if dt == jnp.bfloat16 else "highest"
+            if "gather_sorted_pallas" not in skipped:
+                t = bench(
+                    lambda a: sorted_row_gather(
+                        a, sids, max_vblocks=mv, scatter_mc=mc0,
+                        precision=prec0,
+                    ),
+                    x,
+                )
+                record(op="gather_sorted_pallas", F=F, dtype=dname, mv=mv,
+                       ms=round(t, 3),
+                       gbps=round(E_pad * F * b / t / 1e6, 1))
+        if "segment_sum_xla" not in skipped:
             t = bench(
-                lambda a: sorted_row_gather(
-                    a, sids, max_vblocks=mv, scatter_mc=mc0, precision=prec0,
-                ),
-                x,
+                lambda a: local_ops.segment_sum(
+                    a, sids, N, indices_are_sorted=True), ed
             )
-            record(op="gather_sorted_pallas", F=F, dtype=dname, mv=mv,
-                   ms=round(t, 3), gbps=round(E_pad * F * b / t / 1e6, 1))
-        t = bench(
-            lambda a: local_ops.segment_sum(a, sids, N, indices_are_sorted=True), ed
-        )
-        record(op="segment_sum_xla", F=F, dtype=dname, ms=round(t, 3),
-               gbps=round(E_pad * F * b / t / 1e6, 1))
+            record(op="segment_sum_xla", F=F, dtype=dname, ms=round(t, 3),
+                   gbps=round(E_pad * F * b / t / 1e6, 1))
         if cfg.pallas and on_tpu:
             if cfg.sweep:
                 tiles = [
@@ -139,6 +158,11 @@ def main(cfg: Config):
                 mc = max_chunks_hint(sids_np, N, block_e=be, block_n=bn)
                 precs = ("default",) if dt == jnp.bfloat16 else ("highest", "default")
                 for prec in precs:
+                    # match the family name OR the full recorded op name
+                    # (a user copies the latter from the jsonl/stdout)
+                    if {"segment_sum_pallas",
+                            f"segment_sum_pallas_{prec}"} & skipped:
+                        continue
                     t = bench(
                         lambda a, prec=prec, be=be, bn=bn, mc=mc: sorted_segment_sum(
                             a, sids, N, max_chunks_per_block=mc,
@@ -151,7 +175,7 @@ def main(cfg: Config):
                            gbps=round(E_pad * F * b / t / 1e6, 1))
                 # the gather kernel shares the plan's (block_e, block_n)
                 # fields, so tile winners must be picked for BOTH kernels
-                if cfg.sweep:
+                if cfg.sweep and "gather_sorted_pallas_sweep" not in skipped:
                     # max_vblocks_hint / sorted_row_gather / prec0 are in
                     # scope from the non-sweep gather block above (same
                     # cfg.pallas-and-on_tpu guard)
@@ -167,11 +191,7 @@ def main(cfg: Config):
                            block_e=be, block_n=bn, mv=mv, ms=round(t, 3),
                            gbps=round(E_pad * F * b / t / 1e6, 1))
 
-    if cfg.out:
-        os.makedirs(os.path.dirname(cfg.out) or ".", exist_ok=True)
-        with open(cfg.out, "a") as f:
-            for r in records:
-                f.write(json.dumps(r) + "\n")
+    # records were streamed to cfg.out by record() as they completed
 
 
 if __name__ == "__main__":

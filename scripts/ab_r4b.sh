@@ -1,0 +1,60 @@
+#!/bin/bash
+# Round-4b regression hunt: the first driver-verifiable GCN number
+# (logs/bench_r4_gcn.json, 597.7 ms) is a REGRESSION vs the r1 456.9 ms
+# baseline. One-variable A/Bs on the exact bench harness to bisect where
+# the epoch goes. Each stage commits its artifact (append-only pattern
+# from onchip_r4.sh). GraphCast disabled throughout (GCN-only, fast).
+cd /root/repo
+set -o pipefail
+exec >> logs/ab_r4b.log 2>&1
+date -u +"%Y-%m-%dT%H:%M:%SZ r4b A/B start"
+
+probe() { timeout 90 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() == 'tpu', jax.default_backend()
+float(jnp.ones((8,128)).sum())" >/dev/null 2>&1; }
+
+commit_stage() {
+  name=$1; shift
+  for f in "$@" logs/ab_r4b.log; do
+    [ -e "$f" ] && git add -f "$f"
+  done
+  git commit -q -m "r4b A/B: $name artifacts
+
+No-Verification-Needed: measurement logs only" || true
+}
+
+run_ab() {  # run_ab NAME ENVSTR
+  name=$1; env_str=$2
+  if ! probe; then
+    date -u +"%Y-%m-%dT%H:%M:%SZ $name skipped (lease wedged)"
+    return 1
+  fi
+  env $env_str DGRAPH_BENCH_GRAPHCAST=0 DGRAPH_BENCH_TIMEOUT=2400 \
+    python bench.py > "logs/bench_r4b_${name}.json" 2>"logs/bench_r4b_${name}.err"
+  rc=$?
+  date -u +"%Y-%m-%dT%H:%M:%SZ $name rc=$rc json: $(tail -1 logs/bench_r4b_${name}.json 2>/dev/null)"
+  commit_stage "$name" "logs/bench_r4b_${name}.json" "logs/bench_r4b_${name}.err"
+  return $rc
+}
+
+# 1. Fused kernel with the Mosaic bf16 [:,None] fix: does it pass the
+#    self-check now, and what does fusion buy end-to-end?
+run_ab fusedfix ""
+
+# 2. Pallas scatter OFF (pure XLA segment_sum path): measures the Pallas
+#    scatter's total contribution to the epoch.
+run_ab noscatter "DGRAPH_TPU_PALLAS_SCATTER=0 DGRAPH_TPU_PALLAS_FUSED=0"
+
+# 3. Column chunking OFF (gather_col_block=0): the 128 default rests on
+#    invalidated r2 data (VERDICT r3 weak #2).
+run_ab nocolblk "DGRAPH_TPU_GATHER_COL_BLOCK=0"
+
+# 4. Both off: the minimal all-XLA path.
+run_ab allxla "DGRAPH_TPU_PALLAS_SCATTER=0 DGRAPH_TPU_PALLAS_FUSED=0 DGRAPH_TPU_GATHER_COL_BLOCK=0"
+
+# 5. float32 control (r1's 456.9 baseline may predate the bf16 default;
+#    rules dtype in or out as the regression variable).
+run_ab f32 "DGRAPH_BENCH_DTYPE=float32"
+
+date -u +"%Y-%m-%dT%H:%M:%SZ r4b A/B done"

@@ -1,0 +1,100 @@
+#!/bin/bash
+# Round-4c on-chip queue: regression hunt + the stages the 04:01Z re-wedge
+# killed in onchip_r4.sh. Context: stage-1 headline landed (597.7 ms,
+# logs/bench_r4_gcn.json — a REGRESSION vs 456.9 ms r1 / 421.1 ms r2
+# interim), the sweep's first two rows landed (XLA plain gather 3.9 ms
+# BEATS col_split 5.8 ms at F=128), then the tunnel wedged during
+# gather_sorted_xla dispatch. Ordering below: cheapest decisive A/Bs
+# first, known-wedge-risk stages (GraphCast L6, p100m) last.
+cd /root/repo
+set -o pipefail
+exec >> logs/onchip_r4c.log 2>&1
+date -u +"%Y-%m-%dT%H:%M:%SZ r4c queue start"
+
+probe() { timeout 90 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() == 'tpu', jax.default_backend()
+float(jnp.ones((8,128)).sum())" >/dev/null 2>&1; }
+
+commit_stage() {
+  name=$1; shift
+  for f in "$@" logs/onchip_r4c.log; do
+    [ -e "$f" ] && git add -f "$f"
+  done
+  git commit -q -m "On-chip r4c queue: $name artifacts
+
+No-Verification-Needed: measurement logs only" || true
+}
+
+run_stage() {
+  name=$1; shift
+  if ! probe; then
+    date -u +"%Y-%m-%dT%H:%M:%SZ $name skipped (lease wedged)"
+    return 1
+  fi
+  "$@"
+  rc=$?
+  date -u +"%Y-%m-%dT%H:%M:%SZ $name done rc=$rc"
+  return $rc
+}
+
+bench_ab() {  # bench_ab NAME "ENV=VAL ..."
+  name=$1; env_str=$2
+  run_stage "bench_$name" bash -c "env $env_str DGRAPH_BENCH_GRAPHCAST=0 \
+    DGRAPH_BENCH_TIMEOUT=2400 python bench.py \
+    > logs/bench_r4b_${name}.json 2>logs/bench_r4b_${name}.err"
+  date -u +"%Y-%m-%dT%H:%M:%SZ $name json: $(tail -1 logs/bench_r4b_${name}.json 2>/dev/null)"
+  commit_stage "$name" "logs/bench_r4b_${name}.json" "logs/bench_r4b_${name}.err"
+}
+
+# --- regression hunt: one-variable A/Bs on the exact headline harness ---
+# 1. default with the Mosaic bf16 [:,None] fix (fused kernel should now
+#    pass its self-check)
+bench_ab fusedfix ""
+# 2. column chunking OFF — the invalidated-default suspect; the surviving
+#    sweep rows already show plain beating col_split at F=128
+bench_ab nocolblk "DGRAPH_TPU_GATHER_COL_BLOCK=0"
+# 3. Pallas scatter OFF (pure XLA segment_sum path)
+bench_ab noscatter "DGRAPH_TPU_PALLAS_SCATTER=0 DGRAPH_TPU_PALLAS_FUSED=0"
+# 4. all-XLA minimal path
+bench_ab allxla "DGRAPH_TPU_PALLAS_SCATTER=0 DGRAPH_TPU_PALLAS_FUSED=0 DGRAPH_TPU_GATHER_COL_BLOCK=0"
+
+# 5. op profile (VERDICT r3 #5: the 2x residual; now also localizes the
+#    597 ms regression per-op)
+run_stage op_profile bash -c 'set -o pipefail; timeout 1800 python experiments/op_profile.py 2>&1 | tail -20'
+commit_stage op_profile logs/op_profile.jsonl
+
+# 6. Pallas sorted-row-gather pinned on (original queue stage 3)
+bench_ab gatherk "DGRAPH_TPU_PALLAS_GATHER=1"
+
+# 7. kernel sweep, split per (dtype, F) so one wedge loses at most a
+#    quarter; records stream to the jsonl as they complete now.
+for dt in float32 bfloat16; do
+  for F in 128 256; do
+    run_stage "sweep_${dt}_${F}" bash -c "set -o pipefail; timeout 1800 \
+      python experiments/kernel_benchmarks.py --sweep true --dtypes $dt \
+      --feat_dims $F 2>&1 | tail -5"
+    commit_stage "sweep_${dt}_${F}" logs/kernel_benchmarks.jsonl
+  done
+done
+python scripts/adopt_sweep.py logs/kernel_benchmarks.jsonl > logs/sweep_winners.txt 2>&1 || true
+commit_stage sweep_winners logs/sweep_winners.txt
+
+# 8. flash-attention A/B at seq 8192 (original stage 5)
+for fl in 0 1; do
+  run_stage "lm flash=$fl" bash -c "set -o pipefail; DGRAPH_TPU_FLASH_ATTN=$fl timeout 1200 python experiments/long_context_lm.py --seq_len 8192 --steps 30 --world_size 1 --latent 256 --num_heads 2 --attn_impl ulysses --log_path logs/lm_flash${fl}_onchip.jsonl 2>&1 | tail -2" || break
+done
+commit_stage flash_ab logs/lm_flash0_onchip.jsonl logs/lm_flash1_onchip.jsonl
+
+# 9. GraphCast ladder (original stage 6; known wedge risk — late)
+run_stage bench_graphcast bash -c 'DGRAPH_BENCH_TIMEOUT=3000 python bench.py > logs/bench_r4_full.json 2>logs/bench_r4_full.err'
+date -u +"%Y-%m-%dT%H:%M:%SZ full json: $(tail -1 logs/bench_r4_full.json 2>/dev/null)"
+commit_stage bench_graphcast logs/bench_r4_full.json logs/bench_r4_full.err
+
+# 10. papers100M ladder (original stage 7)
+for s in 0.002 0.005 0.01 0.02; do
+  run_stage "p100m scale=$s" bash -c "set -o pipefail; timeout 2400 python experiments/papers100m_gcn.py --synthetic_scale $s --epochs 3 --world_size 1 --log_path logs/p100m_step.jsonl 2>&1 | tail -5" || break
+done
+commit_stage p100m logs/p100m_step.jsonl
+
+date -u +"%Y-%m-%dT%H:%M:%SZ r4c queue done"

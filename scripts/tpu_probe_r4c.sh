@@ -1,0 +1,15 @@
+#!/bin/bash
+# Probe the tunneled TPU every 2 min; on recovery run the r4c on-chip queue.
+cd /root/repo
+while true; do
+  if timeout 120 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() == 'tpu'
+float(jnp.ones((8,128)).sum())" >/dev/null 2>&1; then
+    date -u +"%Y-%m-%dT%H:%M:%SZ recovered - launching r4c queue" >> logs/tpu_probe.log
+    bash scripts/onchip_r4c.sh
+    exit 0
+  fi
+  date -u +"%Y-%m-%dT%H:%M:%SZ still-wedged" >> logs/tpu_probe.log
+  sleep 120
+done
