@@ -85,7 +85,12 @@ def halo_exchange(
     F = x.shape[-1]
     W, S = halo.send_idx.shape[0], halo.s_pad
     if axis_name is None:
-        send = x[halo.send_idx] * halo.send_mask[..., None]
+        # mask in x's dtype: the plan stores send_mask as f32, and a raw
+        # multiply silently upcasts a bf16 stream — which then upcasts the
+        # halo_extend concat and EVERY downstream [E, F] tensor of the
+        # layer (caught in the r4 TPU export: the whole edge pipeline ran
+        # f32 and the scatter kernel picked its "highest" precision path)
+        send = x[halo.send_idx] * halo.send_mask[..., None].astype(x.dtype)
         return send.reshape(-1, F)  # world size 1: mask is all-zero
     if _use_ppermute(axis_name, deltas):
         me = lax.axis_index(axis_name)
@@ -94,13 +99,13 @@ def halo_exchange(
             peer_row = (me + d) % W
             idx = jnp.take(halo.send_idx, peer_row, axis=0)
             msk = jnp.take(halo.send_mask, peer_row, axis=0)
-            send = x[idx] * msk[..., None]  # [S, F]
+            send = x[idx] * msk[..., None].astype(x.dtype)  # [S, F]
             perm = [(i, (i + d) % W) for i in range(W)]
             recv = lax.ppermute(send, axis_name, perm)
             src_rank = (me - d) % W
             out = lax.dynamic_update_slice(out, recv, (src_rank * S, 0))
         return out
-    send = x[halo.send_idx] * halo.send_mask[..., None]  # [W, S, F]
+    send = x[halo.send_idx] * halo.send_mask[..., None].astype(x.dtype)
     recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0)
     return recv.reshape(-1, F)
 
@@ -139,14 +144,15 @@ def halo_scatter_sum(
             peer_row = (me + d) % W
             idx = jnp.take(halo.send_idx, peer_row, axis=0)
             msk = jnp.take(halo.send_mask, peer_row, axis=0)
-            out = out + local_ops.segment_sum(recv * msk[..., None], idx, n_pad)
+            out = out + local_ops.segment_sum(
+                recv * msk[..., None].astype(h.dtype), idx, n_pad)
         return out
     h = h.reshape(W, S, F)
     if axis_name is None:
         back = h
     else:
         back = lax.all_to_all(h, axis_name, split_axis=0, concat_axis=0)
-    back = back * halo.send_mask[..., None]
+    back = back * halo.send_mask[..., None].astype(back.dtype)
     flat_idx = halo.send_idx.reshape(-1)
     return local_ops.segment_sum(back.reshape(flat_idx.shape[0], -1), flat_idx, n_pad)
 

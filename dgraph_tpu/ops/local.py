@@ -179,7 +179,16 @@ def sorted_segment_sum_any(data, sorted_ids, n_rows, be, bn, mc, gather_mv=0):
             block_e=be, block_n=bn, gather_mv=gather_mv, precision=prec,
         )
     # fallback keeps the col-split-take VJP pinning (segment_sum wrapper),
-    # not jax.ops.segment_sum's plain wide-gather transpose
+    # not jax.ops.segment_sum's plain wide-gather transpose. Accumulate in
+    # f32 like the kernel's VMEM accumulator (and the reference's CUDA
+    # atomicAdd): a bf16 running sum saturates — summing 0/1 masks stalls
+    # at 256 (ulp(256)=2), so e.g. the fused kernel's d_bias degree count
+    # would be wrong up to ~16x on hub vertices.
+    if data.dtype in (jnp.bfloat16, jnp.float16):
+        return segment_sum(
+            data.astype(jnp.float32), sorted_ids, n_rows,
+            indices_are_sorted=True,
+        ).astype(data.dtype)
     return segment_sum(data, sorted_ids, n_rows, indices_are_sorted=True)
 
 
@@ -309,7 +318,7 @@ def _make_segment_sum(num_segments, sorted_ids, col_block):
 
 def masked_gather(src: jax.Array, idx: jax.Array, mask: jax.Array) -> jax.Array:
     """out[i] = src[idx[i]] * mask[i] — ``Rank_Local_Gather_Kernel`` parity."""
-    return row_take(src, idx) * mask[..., None]
+    return row_take(src, idx) * mask[..., None].astype(src.dtype)
 
 
 def masked_scatter(

@@ -388,25 +388,38 @@ def _make_ssbr(num_segments, max_chunks_per_block, block_e, block_n, interpret,
 
         # recompute the activation mask (remat: the [E,F] pre-activation
         # was never materialized in the forward — that's the point); both
-        # row takes are by the plan's sorted ids -> kernel-upgradeable
-        pre = data.astype(jnp.float32) + _take_sorted(
-            bias.astype(jnp.float32), segment_ids, gather_mv,
+        # row takes are by the plan's sorted ids -> kernel-upgradeable.
+        # Every [E, F] tensor that REACHES HBM stays in the COMPUTE dtype:
+        # upcasting the gathers/products to f32 doubled every bwd HBM
+        # stream (the r4 TPU export showed six 1.2 GB f32 [E,128] gathers
+        # per step from exactly this block). The mask itself is still
+        # DECIDED in f32 — the forward kernel computes data+bias[id] in
+        # f32, and a bf16 recompute can flip edges at the ReLU boundary
+        # (an O(|g|) error, not rounding). The f32 add/compare lives in
+        # the fusion's registers; its input streams are bf16.
+        cdt = data.dtype
+        bias_rows = _take_sorted(
+            bias.astype(cdt), segment_ids, gather_mv,
             block_e, block_n, max_chunks_per_block,
         )
-        act = (pre > 0).astype(jnp.float32)
+        pre = data.astype(jnp.float32) + bias_rows.astype(jnp.float32)
+        act = (pre > 0).astype(cdt)
         g_rows = _take_sorted(
-            g.astype(jnp.float32), segment_ids, gather_mv,
+            g.astype(cdt), segment_ids, gather_mv,
             block_e, block_n, max_chunks_per_block,
         )
-        w = edge_weight[:, None].astype(jnp.float32) if has_weight else 1.0
+        w = edge_weight[:, None].astype(cdt) if has_weight else 1.0
         gd = g_rows * act * w  # d/d(data)
-        # d/d(bias[v]) = g[v] * sum_e w_e*act_e  (sorted ids -> fast path)
+        # d/d(bias[v]) = g[v] * sum_e w_e*act_e  (sorted ids -> fast path;
+        # f32 accumulation guaranteed by sorted_segment_sum_any for BOTH
+        # the kernel path (VMEM acc) and the jnp fallback — a bf16
+        # accumulate would saturate the count at vertex degree ~256)
         from dgraph_tpu.ops.local import sorted_segment_sum_any
 
         d_bias = sorted_segment_sum_any(
-            act * w, segment_ids, num_segments, block_e, block_n,
-            max_chunks_per_block,
-        ) * g.astype(jnp.float32)
+            act * w if has_weight else act, segment_ids, num_segments,
+            block_e, block_n, max_chunks_per_block,
+        ).astype(jnp.float32) * g.astype(jnp.float32)
         if has_weight:
             d_w = (g_rows * jnp.maximum(pre, 0)).sum(axis=-1).astype(
                 edge_weight.dtype

@@ -40,11 +40,20 @@ run_stage() {
 
 bench_ab() {  # bench_ab NAME "ENV=VAL ..."
   name=$1; env_str=$2
-  run_stage "bench_$name" bash -c "env $env_str DGRAPH_BENCH_GRAPHCAST=0 \
+  if ! probe; then
+    # return BEFORE tail/commit: committing a pre-existing
+    # logs/bench_r4b_${name}.json from an earlier run would label stale
+    # data as this stage's artifact
+    date -u +"%Y-%m-%dT%H:%M:%SZ bench_$name skipped (lease wedged)"
+    return 1
+  fi
+  bash -c "env $env_str DGRAPH_BENCH_GRAPHCAST=0 \
     DGRAPH_BENCH_TIMEOUT=2400 python bench.py \
     > logs/bench_r4b_${name}.json 2>logs/bench_r4b_${name}.err"
-  date -u +"%Y-%m-%dT%H:%M:%SZ $name json: $(tail -1 logs/bench_r4b_${name}.json 2>/dev/null)"
+  rc=$?
+  date -u +"%Y-%m-%dT%H:%M:%SZ bench_$name done rc=$rc json: $(tail -1 logs/bench_r4b_${name}.json 2>/dev/null)"
   commit_stage "$name" "logs/bench_r4b_${name}.json" "logs/bench_r4b_${name}.err"
+  return $rc
 }
 
 # --- regression hunt: one-variable A/Bs on the exact headline harness ---
@@ -58,6 +67,9 @@ bench_ab nocolblk "DGRAPH_TPU_GATHER_COL_BLOCK=0"
 bench_ab noscatter "DGRAPH_TPU_PALLAS_SCATTER=0 DGRAPH_TPU_PALLAS_FUSED=0"
 # 4. all-XLA minimal path
 bench_ab allxla "DGRAPH_TPU_PALLAS_SCATTER=0 DGRAPH_TPU_PALLAS_FUSED=0 DGRAPH_TPU_GATHER_COL_BLOCK=0"
+# 4b. float32 control (rules dtype in/out as the regression variable vs
+#     the r1 456.9 ms recording)
+bench_ab f32 "DGRAPH_BENCH_DTYPE=float32"
 
 # 5. op profile (VERDICT r3 #5: the 2x residual; now also localizes the
 #    597 ms regression per-op)
