@@ -121,9 +121,7 @@ def _make_take_rows(n_rows, sorted_ids, col_block, pallas, block_e, block_n,
                 block_e=block_e, block_n=block_n, precision=prec,
             )
         else:
-            dx = jax.ops.segment_sum(
-                g, idx, num_segments=n_rows, indices_are_sorted=sorted_ids
-            )
+            dx = _acc_segment_sum(g, idx, n_rows, sorted_ids)
         return dx, None
 
     take.defvjp(fwd, bwd)
@@ -179,16 +177,9 @@ def sorted_segment_sum_any(data, sorted_ids, n_rows, be, bn, mc, gather_mv=0):
             block_e=be, block_n=bn, gather_mv=gather_mv, precision=prec,
         )
     # fallback keeps the col-split-take VJP pinning (segment_sum wrapper),
-    # not jax.ops.segment_sum's plain wide-gather transpose. Accumulate in
-    # f32 like the kernel's VMEM accumulator (and the reference's CUDA
-    # atomicAdd): a bf16 running sum saturates — summing 0/1 masks stalls
-    # at 256 (ulp(256)=2), so e.g. the fused kernel's d_bias degree count
-    # would be wrong up to ~16x on hub vertices.
-    if data.dtype in (jnp.bfloat16, jnp.float16):
-        return segment_sum(
-            data.astype(jnp.float32), sorted_ids, n_rows,
-            indices_are_sorted=True,
-        ).astype(data.dtype)
+    # not jax.ops.segment_sum's plain wide-gather transpose; the wrapper's
+    # reduction runs through _acc_segment_sum, so low-precision inputs
+    # accumulate in f32 exactly like the kernel's VMEM accumulator.
     return segment_sum(data, sorted_ids, n_rows, indices_are_sorted=True)
 
 
@@ -212,10 +203,27 @@ def sorted_segment_sum_bias_relu_any(
             max_chunks_per_block=mc, block_e=be, block_n=bn,
             gather_mv=gather_mv, precision=prec,
         )
-    m = jax.nn.relu(edata + row_take(bias, sorted_ids, oob="fill"))
+    # take via take_rows WITH the sorted hints so the bias-gradient
+    # transpose rides the sorted segment-sum path, not XLA scatter-add;
+    # hints honor the scatter kill switch (a vetoed kernel must not keep
+    # running via the hinted VJP, and the noscatter A/Bs must really
+    # measure the XLA path)
+    hints = ((be, bn, mc)
+             if _cfg.pallas_scatter_enabled() else None)
+    bias_rows = take_rows(
+        bias, sorted_ids, indices_are_sorted=True,
+        pallas_hints=hints, gather_mv=gather_mv,
+    )
+    m = jax.nn.relu(edata + bias_rows)
     if edge_weight is not None:
         m = m * edge_weight[:, None].astype(m.dtype)
-    return segment_sum(m, sorted_ids, n_rows, indices_are_sorted=True)
+    # route the reduction through sorted_segment_sum_any, NOT the plain
+    # wrapper: with the fused kernel off but the plain scatter on (the
+    # r4 bench exactly — fused self-check vetoed by the Mosaic bf16 bug)
+    # the wrapper sent the model's MAIN aggregation to XLA scatter-add,
+    # bypassing the healthy Pallas kernel
+    return sorted_segment_sum_any(m, sorted_ids, n_rows, be, bn, mc,
+                                  gather_mv=gather_mv)
 
 
 @functools.lru_cache(maxsize=None)
@@ -293,6 +301,25 @@ def segment_sum_sort_route(data, ids, perm, sorted_ids, n_rows, *,
     )
 
 
+def _acc_segment_sum(data, ids, num_segments, indices_are_sorted):
+    """``jax.ops.segment_sum`` with a 32-bit accumulator for low-precision
+    data: a bf16 running sum saturates (1.0 < ulp(256) = 2, so summing
+    0/1 masks stalls at 256 and hub-vertex feature sums lose terms the
+    same way). The Pallas kernels accumulate f32 in VMEM and the
+    reference accumulates via f32 atomicAdd — every XLA reduction path
+    goes through here so the three implementations agree to one output
+    rounding."""
+    if data.dtype in (jnp.bfloat16, jnp.float16):
+        return jax.ops.segment_sum(
+            data.astype(jnp.float32), ids, num_segments=num_segments,
+            indices_are_sorted=indices_are_sorted,
+        ).astype(data.dtype)
+    return jax.ops.segment_sum(
+        data, ids, num_segments=num_segments,
+        indices_are_sorted=indices_are_sorted,
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def _make_segment_sum(num_segments, sorted_ids, col_block):
     """segment_sum whose VJP is a column-split take (the >128-lane row
@@ -302,9 +329,7 @@ def _make_segment_sum(num_segments, sorted_ids, col_block):
 
     @jax.custom_vjp
     def segsum(data, ids):
-        return jax.ops.segment_sum(
-            data, ids, num_segments=num_segments, indices_are_sorted=sorted_ids
-        )
+        return _acc_segment_sum(data, ids, num_segments, sorted_ids)
 
     def fwd(data, ids):
         return segsum(data, ids), ids
@@ -352,12 +377,8 @@ def segment_sum(
         return _make_segment_sum(
             num_segments, indices_are_sorted, _cfg.gather_col_block
         )(data, segment_ids)
-    return jax.ops.segment_sum(
-        data,
-        segment_ids,
-        num_segments=num_segments,
-        indices_are_sorted=indices_are_sorted,
-    )
+    return _acc_segment_sum(data, segment_ids, num_segments,
+                            indices_are_sorted)
 
 
 def scatter_add_relu(

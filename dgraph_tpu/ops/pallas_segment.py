@@ -398,6 +398,12 @@ def _make_ssbr(num_segments, max_chunks_per_block, block_e, block_n, interpret,
         # (an O(|g|) error, not rounding). The f32 add/compare lives in
         # the fusion's registers; its input streams are bf16.
         cdt = data.dtype
+        # bias.astype(cdt) matches the FORWARD's rounding, not a new one:
+        # the kernel computes bias_rows = dot(onehot, bias_ref.astype(
+        # chunk.dtype)) — i.e. the forward's mask also sees bias rounded
+        # to the data dtype (a one-hot contraction of cdt values under a
+        # f32 preferred_element_type is exact), so fwd/bwd masks agree
+        # even for an f32 bias passed with bf16 data.
         bias_rows = _take_sorted(
             bias.astype(cdt), segment_ids, gather_mv,
             block_e, block_n, max_chunks_per_block,

@@ -1,0 +1,155 @@
+"""Edge-pipeline dtype discipline: a bf16 model must not materialize f32
+[e_pad, F] intermediates.
+
+Regression guard for the r4 on-chip finding: ``halo_exchange`` multiplied
+send rows by the plan's f32 ``send_mask``, upcasting the halo rows, then the
+``halo_extend`` concat upcast the whole vertex table — every [E, F] tensor
+of the bf16 GCN epoch (takes, relu, scatter inputs, cotangents) silently ran
+in f32. That doubled the HBM bytes of the edge pipeline (the dominant
+traffic: E >> N) and flipped the Pallas segment-sum to its 3-pass "highest"
+MXU precision, which is selected by input dtype. The reference hits the
+same class of bug with implicit CUDA type promotion; its kernels pin dtypes
+at the C++ signature level (``local_data_kernels.cuh``) — here the pin is
+this jaxpr walk.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgraph_tpu.comm import Communicator
+from dgraph_tpu.plan import build_edge_plan
+
+
+# Ops whose edge-sized operands/results MUST materialize in HBM (fusion
+# barriers). Elementwise f32 (convert/add/compare chains) fuses into
+# registers and is allowed — e.g. the fused bwd decides its ReLU mask via
+# an f32 add+compare whose streams are bf16.
+_BARRIERS = frozenset({
+    "gather", "scatter", "scatter-add", "pallas_call", "concatenate",
+    "sort", "dynamic_update_slice", "all_to_all", "ppermute",
+})
+
+
+def _edge_sized_scatter_adds(jaxpr, e_pad, out):
+    """Collect every scatter-add whose updates are [e_pad, ...] — with the
+    Pallas scatter enabled these must not exist: the r4 bench's 597 ms
+    regression was the fused-fallback path sending the model's main
+    aggregation to XLA scatter-add while the healthy Pallas kernel sat
+    idle (local.py sorted_segment_sum_bias_relu_any routing)."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in ("scatter-add", "scatter"):
+            for v in eqn.invars:
+                aval = getattr(v, "aval", None)
+                if (
+                    aval is not None
+                    and getattr(aval, "shape", ())
+                    and aval.shape[0] == e_pad
+                    and len(aval.shape) > 1
+                ):
+                    out.append((eqn.primitive.name, tuple(aval.shape)))
+        for p in eqn.params.values():
+            for item in p if isinstance(p, (list, tuple)) else [p]:
+                inner = getattr(item, "jaxpr", None)
+                if inner is not None:
+                    _edge_sized_scatter_adds(
+                        getattr(inner, "jaxpr", inner), e_pad, out)
+                elif hasattr(item, "eqns"):
+                    _edge_sized_scatter_adds(item, e_pad, out)
+    return out
+
+
+def _edge_sized_f32_at_barriers(jaxpr, e_pad, out):
+    """Collect (primitive, shape) for every f32 operand/result with
+    leading dim == e_pad at a fusion-barrier op, recursing into
+    sub-jaxprs (custom_vjp/custom_jvp bodies, scan, pjit, remat)."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _BARRIERS:
+            for v in list(eqn.outvars) + list(eqn.invars):
+                aval = getattr(v, "aval", None)
+                if (
+                    aval is not None
+                    and getattr(aval, "shape", ())
+                    and aval.shape[0] == e_pad
+                    and aval.dtype == jnp.float32
+                ):
+                    out.append((eqn.primitive.name, tuple(aval.shape)))
+        for p in eqn.params.values():
+            for item in p if isinstance(p, (list, tuple)) else [p]:
+                inner = getattr(item, "jaxpr", None)
+                if inner is not None:
+                    _edge_sized_f32_at_barriers(
+                        getattr(inner, "jaxpr", inner), e_pad, out)
+                elif hasattr(item, "eqns"):
+                    _edge_sized_f32_at_barriers(item, e_pad, out)
+    return out
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_bf16_gcn_epoch_has_no_f32_edge_tensors(fused):
+    from dgraph_tpu import config as cfg
+    from dgraph_tpu.models import GCN
+
+    V, E_half, F, C, H = 2_048, 8_192, 32, 8, 64
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, V, E_half)
+    dst = rng.integers(0, V, E_half)
+    edge_index = np.stack(
+        [np.concatenate([src, dst]), np.concatenate([dst, src])]
+    ).astype(np.int64)
+    plan_np, _ = build_edge_plan(
+        edge_index, np.zeros(V, np.int32), world_size=1, edge_owner="dst",
+        pad_multiple=128,
+    )
+    plan = jax.tree.map(lambda l: jnp.asarray(np.asarray(l)[0]), plan_np)
+    e_pad = int(plan_np.e_pad)
+
+    old = (cfg.use_pallas_scatter, cfg.use_pallas_fused)
+    # The discipline is a property of the TPU program: the dispatch gates
+    # read jax.default_backend() at trace time, so patch it to "tpu" for
+    # the make_jaxpr call (tracing never executes a kernel). The CPU
+    # fallback intentionally upcasts to f32 for accumulation correctness
+    # — that path is exempt by construction here.
+    cfg.set_flags(use_pallas_scatter=True, use_pallas_fused=fused)
+    orig_db = jax.default_backend
+    jax.default_backend = lambda: "tpu"
+    try:
+        comm = Communicator.init_process_group("single")
+        model = GCN(
+            hidden_features=H, out_features=C, comm=comm, num_layers=2,
+            dtype=jnp.bfloat16,
+        )
+        x = jnp.zeros((plan_np.n_src_pad, F), jnp.float32)
+        y = jnp.zeros((plan_np.n_src_pad,), jnp.int32)
+        mask = (jnp.arange(plan_np.n_src_pad) < V).astype(jnp.float32)
+        params = jax.eval_shape(lambda: model.init(jax.random.key(0), x, plan))
+        params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), params)
+
+        def loss_and_grad(p):
+            def lf(p_):
+                logits = model.apply(p_, x, plan)
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+                ll = jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+                return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+            return jax.value_and_grad(lf)(p)
+
+        jaxpr = jax.make_jaxpr(loss_and_grad)(params)
+        bad = _edge_sized_f32_at_barriers(jaxpr.jaxpr, e_pad, [])
+        # [e_pad]-sized 1-D f32 and [e_pad, 1] masks are fine (edge
+        # weights/masks, skinny); the discipline is about [e_pad, F]
+        # STREAMS
+        bad = [(n, s) for (n, s) in bad if len(s) > 1 and s[-1] > 1]
+        assert not bad, (
+            f"bf16 GCN (fused={fused}) materializes f32 edge-sized tensors "
+            f"(doubles edge-pipeline HBM traffic): {bad[:8]}"
+        )
+        rogue = _edge_sized_scatter_adds(jaxpr.jaxpr, e_pad, [])
+        assert not rogue, (
+            f"bf16 GCN (fused={fused}) with the Pallas scatter enabled "
+            f"still routes edge-sized reductions to XLA scatter: {rogue[:8]}"
+        )
+    finally:
+        jax.default_backend = orig_db
+        cfg.set_flags(use_pallas_scatter=old[0], use_pallas_fused=old[1])
