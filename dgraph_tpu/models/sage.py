@@ -31,12 +31,16 @@ class SAGEConv(nn.Module):
 
         dt = _cfg.resolve_compute_dtype(self.dtype)
         F = x.shape[-1]
+        # cast BEFORE the edge pipeline: aggregating the raw f32 input
+        # would run every [e_pad, F] take/scatter at double width (the
+        # dtype-discipline rule — see tests/test_dtype_discipline.py)
+        xa = x.astype(dt) if dt is not None else x
         if plan.halo_side != "dst":
             # feature-chunked neighbor sum (models/gcn.py rationale): the
             # per-edge op here is IDENTITY, so chunking is exact for any
             # activation; one full-width halo exchange, local work in
             # <=col_block-wide slices, concat only at the vertex level
-            x_ext = self.comm.halo_extend(x, plan, side="src")
+            x_ext = self.comm.halo_extend(xa, plan, side="src")
             agg = map_feature_chunks(
                 lambda sl: self.comm.scatter_sum(
                     self.comm.local_take(x_ext[:, sl], plan, side="src"),
@@ -45,11 +49,13 @@ class SAGEConv(nn.Module):
                 F,
             )
         else:
-            h_src = self.comm.gather(x, plan, side="src")  # [e_pad, F]
+            h_src = self.comm.gather(xa, plan, side="src")  # [e_pad, F]
             agg = self.comm.scatter_sum(h_src, plan, side="dst")  # [n_pad, F]
         ones = plan.edge_mask[:, None]
         deg = self.comm.scatter_sum(ones, plan, side="dst")  # [n_pad, 1]
-        mean_nbr = agg / jnp.maximum(deg, 1.0)
+        # divide in agg's dtype: a f32 degree would promote mean_nbr to a
+        # full-width f32 vertex tensor
+        mean_nbr = agg / jnp.maximum(deg, 1.0).astype(agg.dtype)
         out = nn.Dense(self.out_features, dtype=dt)(x) + nn.Dense(
             self.out_features, use_bias=False, dtype=dt
         )(mean_nbr)

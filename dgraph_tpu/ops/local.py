@@ -194,6 +194,10 @@ def sorted_segment_sum_bias_relu_any(
     HERE, not at call sites."""
     from dgraph_tpu import config as _cfg
 
+    # precision policy lives HERE: the kernel casts bias to the data dtype
+    # internally; the composed fallback must match, or a f32 bias with
+    # bf16 edata would promote every [e_pad, F] tensor of the fallback
+    bias = bias.astype(edata.dtype)
     if _cfg.pallas_fused_enabled() and jax.default_backend() == "tpu":
         from dgraph_tpu.ops.pallas_segment import sorted_segment_sum_bias_relu
 

@@ -32,13 +32,29 @@ _BARRIERS = frozenset({
 })
 
 
+def _walk_eqns(jaxpr, visit):
+    """Call ``visit(eqn)`` on every eqn, recursing into sub-jaxprs
+    (custom_vjp/custom_jvp bodies, scan, pjit, remat) — ONE traversal
+    shared by both collectors so the descent logic cannot drift."""
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for p in eqn.params.values():
+            for item in p if isinstance(p, (list, tuple)) else [p]:
+                inner = getattr(item, "jaxpr", None)
+                if inner is not None:
+                    _walk_eqns(getattr(inner, "jaxpr", inner), visit)
+                elif hasattr(item, "eqns"):
+                    _walk_eqns(item, visit)
+
+
 def _edge_sized_scatter_adds(jaxpr, e_pad, out):
     """Collect every scatter-add whose updates are [e_pad, ...] — with the
     Pallas scatter enabled these must not exist: the r4 bench's 597 ms
     regression was the fused-fallback path sending the model's main
     aggregation to XLA scatter-add while the healthy Pallas kernel sat
     idle (local.py sorted_segment_sum_bias_relu_any routing)."""
-    for eqn in jaxpr.eqns:
+
+    def visit(eqn):
         if eqn.primitive.name in ("scatter-add", "scatter"):
             for v in eqn.invars:
                 aval = getattr(v, "aval", None)
@@ -49,22 +65,16 @@ def _edge_sized_scatter_adds(jaxpr, e_pad, out):
                     and len(aval.shape) > 1
                 ):
                     out.append((eqn.primitive.name, tuple(aval.shape)))
-        for p in eqn.params.values():
-            for item in p if isinstance(p, (list, tuple)) else [p]:
-                inner = getattr(item, "jaxpr", None)
-                if inner is not None:
-                    _edge_sized_scatter_adds(
-                        getattr(inner, "jaxpr", inner), e_pad, out)
-                elif hasattr(item, "eqns"):
-                    _edge_sized_scatter_adds(item, e_pad, out)
+
+    _walk_eqns(jaxpr, visit)
     return out
 
 
 def _edge_sized_f32_at_barriers(jaxpr, e_pad, out):
     """Collect (primitive, shape) for every f32 operand/result with
-    leading dim == e_pad at a fusion-barrier op, recursing into
-    sub-jaxprs (custom_vjp/custom_jvp bodies, scan, pjit, remat)."""
-    for eqn in jaxpr.eqns:
+    leading dim == e_pad at a fusion-barrier op."""
+
+    def visit(eqn):
         if eqn.primitive.name in _BARRIERS:
             for v in list(eqn.outvars) + list(eqn.invars):
                 aval = getattr(v, "aval", None)
@@ -75,15 +85,63 @@ def _edge_sized_f32_at_barriers(jaxpr, e_pad, out):
                     and aval.dtype == jnp.float32
                 ):
                     out.append((eqn.primitive.name, tuple(aval.shape)))
-        for p in eqn.params.values():
-            for item in p if isinstance(p, (list, tuple)) else [p]:
-                inner = getattr(item, "jaxpr", None)
-                if inner is not None:
-                    _edge_sized_f32_at_barriers(
-                        getattr(inner, "jaxpr", inner), e_pad, out)
-                elif hasattr(item, "eqns"):
-                    _edge_sized_f32_at_barriers(item, e_pad, out)
+
+    _walk_eqns(jaxpr, visit)
     return out
+
+
+def test_bf16_sage_fwd_bwd_discipline():
+    """SAGE aggregates the INPUT features (not a projection), so it has
+    its own upcast hazard: gathering the raw f32 x through the edge
+    pipeline. Pinned after the r4 audit found exactly that."""
+    from dgraph_tpu import config as cfg
+    from dgraph_tpu.models.sage import GraphSAGE
+
+    V, E_half, F = 2_048, 8_192, 32
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, V, E_half)
+    dst = rng.integers(0, V, E_half)
+    edge_index = np.stack(
+        [np.concatenate([src, dst]), np.concatenate([dst, src])]
+    ).astype(np.int64)
+    plan_np, _ = build_edge_plan(
+        edge_index, np.zeros(V, np.int32), world_size=1, edge_owner="dst",
+        pad_multiple=128,
+    )
+    plan = jax.tree.map(lambda l: jnp.asarray(np.asarray(l)[0]), plan_np)
+    e_pad = int(plan_np.e_pad)
+
+    old = (cfg.use_pallas_scatter, cfg.use_pallas_fused)
+    cfg.set_flags(use_pallas_scatter=True, use_pallas_fused=True)
+    orig_db = jax.default_backend
+    jax.default_backend = lambda: "tpu"
+    try:
+        comm = Communicator.init_process_group("single")
+        model = GraphSAGE(
+            hidden_features=64, out_features=8, comm=comm, num_layers=2,
+            dtype=jnp.bfloat16,
+        )
+        x = jnp.zeros((plan_np.n_src_pad, F), jnp.float32)
+        params = jax.eval_shape(lambda: model.init(jax.random.key(0), x, plan))
+        params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), params)
+
+        def lf(p):
+            out = model.apply(p, x, plan)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        jaxpr = jax.make_jaxpr(jax.grad(lf))(params)
+        bad = _edge_sized_f32_at_barriers(jaxpr.jaxpr, e_pad, [])
+        bad = [(n, s) for (n, s) in bad if len(s) > 1 and s[-1] > 1]
+        assert not bad, f"bf16 SAGE f32 edge tensors at barriers: {bad[:8]}"
+        # rogue check: [e_pad, 1] degree-count scatters are allowed
+        # (narrow, measured-decision pending — see r4c notes); WIDE
+        # edge reductions must ride the Pallas path
+        rogue = _edge_sized_scatter_adds(jaxpr.jaxpr, e_pad, [])
+        rogue = [(n, s) for (n, s) in rogue if s[-1] > 8]
+        assert not rogue, f"bf16 SAGE wide XLA edge scatters: {rogue[:8]}"
+    finally:
+        jax.default_backend = orig_db
+        cfg.set_flags(use_pallas_scatter=old[0], use_pallas_fused=old[1])
 
 
 @pytest.mark.parametrize("fused", [False, True])
