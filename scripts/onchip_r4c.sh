@@ -98,6 +98,15 @@ for fl in 0 1; do
 done
 commit_stage flash_ab logs/lm_flash0_onchip.jsonl logs/lm_flash1_onchip.jsonl
 
+# 8b. First on-chip RGAT record (arxiv-scale synthetic MAG, bf16): also
+#     measures the narrow [E, heads] attention-softmax XLA scatters the
+#     r4c audit flagged — decides whether they get the Pallas pad-route.
+if run_stage rgat bash -c 'set -o pipefail; DGRAPH_TPU_COMPUTE_DTYPE=bfloat16 timeout 1800 python experiments/rgat_mag.py --num_papers 200000 --num_authors 120000 --num_institutions 12000 --epochs 12 --world_size 1 --plan_cache "" --log_path logs/rgat_onchip.jsonl 2>&1 | tail -3'; then
+  # commit only on a completed run: a probe-skip must not relabel a prior
+  # partial jsonl as this stage's artifact (same hazard bench_ab guards)
+  commit_stage rgat logs/rgat_onchip.jsonl
+fi
+
 # 9. GraphCast ladder (original stage 6; known wedge risk — late)
 run_stage bench_graphcast bash -c 'DGRAPH_BENCH_TIMEOUT=3000 python bench.py > logs/bench_r4_full.json 2>logs/bench_r4_full.err'
 date -u +"%Y-%m-%dT%H:%M:%SZ full json: $(tail -1 logs/bench_r4_full.json 2>/dev/null)"
