@@ -201,6 +201,45 @@ def pallas_fused_selfcheck() -> bool:
                 ).astype(jnp.float32),
                 ref, tol,
             )
+    # gradient check: the unweighted VJP runs the fused-bwd KERNEL PAIR
+    # (chunk-major gd kernel + epilogue="act" d_bias reduction) when
+    # gather_mv > 0 — a Mosaic miscompile there would silently corrupt
+    # training, so the chip gate must cover it too. Reference grads by
+    # numpy: d_data[e] = act_e * g[ids[e]]; d_bias[v] = g[v] * count_v.
+    from dgraph_tpu.ops.pallas_segment import max_vblocks_hint
+
+    mv = max_vblocks_hint(ids, N, block_e=be, block_n=bn)
+    tgt = rng.standard_normal((N, F)).astype(np.float32)
+    gd_want = np.zeros((E, F), np.float32)
+    db_want = np.zeros((N, F), np.float32)
+    for e in range(E):
+        if ids[e] >= N:
+            continue
+        act_e = (data[e] + bias[ids[e]] > 0).astype(np.float32)
+        gd_want[e] = act_e * tgt[ids[e]]
+        db_want[ids[e]] += act_e
+    db_want *= tgt
+
+    def loss(d, b):
+        out = sorted_segment_sum_bias_relu(
+            d, jnp.asarray(ids), b, N, max_chunks_per_block=mc,
+            block_e=be, block_n=bn, gather_mv=mv, precision="highest",
+        )
+        return (out.astype(jnp.float32) * jnp.asarray(tgt)).sum()
+
+    def grads():
+        gd, db = jax.grad(loss, argnums=(0, 1))(
+            jnp.asarray(data), jnp.asarray(bias)
+        )
+        # one array so _check_one's single compare covers both
+        return jnp.concatenate(
+            [gd.astype(jnp.float32).ravel(), db.astype(jnp.float32).ravel()]
+        )
+
+    ok &= _check_one(
+        "fused-bwd-kernel-pair(grads,f32)", grads,
+        np.concatenate([gd_want.ravel(), db_want.ravel()]), 2e-4,
+    )
     return ok
 
 

@@ -258,7 +258,7 @@ def sorted_segment_sum(
 
 def _kernel_bias_relu(
     starts_ref, counts_ref, ids_ref, *refs,
-    block_n, block_e, precision, has_weight,
+    block_n, block_e, precision, has_weight, epilogue="relu",
 ):
     """out[v] += sum_e onehot[e,v] * w[e] * relu(data[e] + bias[v]).
 
@@ -269,6 +269,10 @@ def _kernel_bias_relu(
     ``Fused_Sum_Norm_Scatter_Kernel``, ``local_data_kernels.cuh:34-116``):
     XLA alone cannot do it because ``pallas_call`` is a fusion barrier, so
     the [E, F] message tensor would round-trip HBM.
+
+    ``epilogue="act"`` accumulates w[e] * 1[data[e]+bias[v] > 0] instead —
+    the VJP's d_bias reduction (d_bias[v] = g[v] * Σ w·act), computed from
+    ONE pass over data with no [E, F] HBM intermediates.
     """
     if has_weight:
         wgt_ref, data_ref, bias_ref, out_ref = refs
@@ -298,7 +302,11 @@ def _kernel_bias_relu(
             preferred_element_type=jnp.float32, precision=precision,
         )
         in_dtype = data_ref.dtype
-        chunk = jnp.maximum(chunk.astype(jnp.float32) + bias_rows, 0)
+        pre = chunk.astype(jnp.float32) + bias_rows
+        if epilogue == "act":
+            chunk = (pre > 0).astype(jnp.float32)
+        else:
+            chunk = jnp.maximum(pre, 0)
         if has_weight:
             # cast BEFORE the [:, None]: Mosaic can only insert a minor dim
             # on 32-bit vectors (bf16 here fails "Insertion of minor dim
@@ -335,7 +343,7 @@ def _take_sorted(g, ids, gather_mv, block_e, block_n, mc):
 @functools.lru_cache(maxsize=None)
 def _make_ssbr(num_segments, max_chunks_per_block, block_e, block_n, interpret,
                precision, has_weight, gather_mv=0):
-    def impl(data, segment_ids, bias, edge_weight):
+    def impl(data, segment_ids, bias, edge_weight, epilogue="relu"):
         E, F = data.shape
         sched = _ChunkSchedule(
             segment_ids, num_segments, E, block_e=block_e, block_n=block_n,
@@ -367,11 +375,16 @@ def _make_ssbr(num_segments, max_chunks_per_block, block_e, block_n, interpret,
             functools.partial(
                 _kernel_bias_relu, block_n=block_n, block_e=block_e,
                 precision=_precision(precision), has_weight=has_weight,
+                epilogue=epilogue,
             ),
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((sched.N_pad, F), jnp.float32),
             interpret=interpret,
         )(sched.chunk_start, sched.chunk_counts, *operands)
+        if epilogue != "relu":
+            # the act-count reduction is bwd-internal and vertex-sized —
+            # keep the f32 accumulator precision (a bf16 count saturates)
+            return out[:num_segments]
         return out[:num_segments].astype(data.dtype)
 
     @jax.custom_vjp
@@ -385,10 +398,31 @@ def _make_ssbr(num_segments, max_chunks_per_block, block_e, block_n, interpret,
 
     def bwd(res, g):
         data, segment_ids, bias, edge_weight = res
+        cdt = data.dtype
 
-        # recompute the activation mask (remat: the [E,F] pre-activation
-        # was never materialized in the forward — that's the point); both
-        # row takes are by the plan's sorted ids -> kernel-upgradeable.
+        # fused-bwd kernel pair (unweighted path): gd from ONE chunk-major
+        # pass (no bias-rows take, no g-rows take, no act tensor — the
+        # composed bwd streams all three through HBM), d_bias's Σact from
+        # ONE vblock-major pass (epilogue="act"). Engages when the plan
+        # carried the vblock-span hint (gather_mv) and the kernels can run
+        # (TPU, or interpret mode for tests); the fused kill switch
+        # already gated entry into this op at the dispatch point.
+        if (not has_weight and gather_mv > 0
+                and (interpret or jax.default_backend() == "tpu")):
+            gd = _make_fused_bwd(
+                num_segments, gather_mv, block_e, block_n, interpret,
+                precision,
+            )(data, g.astype(cdt), bias.astype(cdt), segment_ids)
+            sum_act = impl(data, segment_ids, bias, edge_weight,
+                           epilogue="act")  # f32 [N, F]
+            d_bias = sum_act * g.astype(jnp.float32)
+            return (gd, None, d_bias.astype(bias.dtype),
+                    jnp.zeros_like(edge_weight))
+
+        # composed fallback: recompute the activation mask (remat: the
+        # [E,F] pre-activation was never materialized in the forward —
+        # that's the point); both row takes are by the plan's sorted ids
+        # -> kernel-upgradeable.
         # Every [E, F] tensor that REACHES HBM stays in the COMPUTE dtype:
         # upcasting the gathers/products to f32 doubled every bwd HBM
         # stream (the r4 TPU export showed six 1.2 GB f32 [E,128] gathers
@@ -397,7 +431,6 @@ def _make_ssbr(num_segments, max_chunks_per_block, block_e, block_n, interpret,
         # f32, and a bf16 recompute can flip edges at the ReLU boundary
         # (an O(|g|) error, not rounding). The f32 add/compare lives in
         # the fusion's registers; its input streams are bf16.
-        cdt = data.dtype
         # bias.astype(cdt) matches the FORWARD's rounding, not a new one:
         # the kernel computes bias_rows = dot(onehot, bias_ref.astype(
         # chunk.dtype)) — i.e. the forward's mask also sees bias rounded
@@ -483,6 +516,70 @@ def max_chunks_hint(
 # --- sorted row gather: the transpose kernel -------------------------------
 
 
+class _VBlockSchedule:
+    """Chunk-major scheduling for sorted-id kernels whose output block is
+    an EDGE chunk and whose inner grid dim iterates the chunk's vertex-
+    block span (sorted_row_gather, the fused-bwd gd kernel). The shared
+    scaffold: edge/vertex padding, per-chunk span bounds, and the clamped
+    vertex-block index map."""
+
+    def __init__(self, ids, num_rows, E, *, block_e, block_n, max_vblocks):
+        self.E = E
+        self.E_pad = pl.cdiv(E, block_e) * block_e
+        self.N_pad = pl.cdiv(num_rows, block_n) * block_n
+        self.nb = self.N_pad // block_n
+        self.num_chunks = self.E_pad // block_e
+        self.block_e, self.block_n = block_e, block_n
+        ids_p = ids
+        if self.E_pad != E:
+            ids_p = jnp.pad(ids, (0, self.E_pad - E),
+                            constant_values=num_rows + 1)
+        self.ids3d = ids_p.reshape(self.num_chunks, 1, block_e)
+        # per-chunk vertex-block span (ids sorted within each chunk):
+        # first/last element of the chunk, clamped into [0, nb)
+        firsts = jnp.clip(ids_p.reshape(self.num_chunks, block_e)[:, 0], 0,
+                          self.N_pad - 1)
+        lasts = jnp.clip(ids_p.reshape(self.num_chunks, block_e)[:, -1], 0,
+                         self.N_pad - 1)
+        self.vb_start = (firsts // block_n).astype(jnp.int32)
+        self.vb_counts = jnp.minimum(
+            (lasts // block_n).astype(jnp.int32) - self.vb_start + 1,
+            max_vblocks,
+        ).astype(jnp.int32)
+
+    def pad_vertices(self, x):
+        if self.N_pad != x.shape[0]:
+            x = jnp.pad(x, ((0, self.N_pad - x.shape[0]), (0, 0)))
+        return x
+
+    def pad_edges(self, arr):
+        if self.E_pad != arr.shape[0]:
+            arr = jnp.pad(
+                arr, ((0, self.E_pad - arr.shape[0]),)
+                + ((0, 0),) * (arr.ndim - 1))
+        return arr
+
+    def vtx_index(self, k, j, starts, counts):
+        # clamp past-count iterations onto the last valid block: Mosaic
+        # skips the DMA when consecutive steps map to the same block
+        return (
+            jnp.minimum(
+                starts[k] + jnp.minimum(j, jnp.maximum(counts[k] - 1, 0)),
+                self.nb - 1,
+            ),
+            0,
+        )
+
+    def vtx_spec(self, F):
+        return pl.BlockSpec((self.block_n, F), self.vtx_index)
+
+    def ids_spec(self):
+        return pl.BlockSpec((1, 1, self.block_e), lambda k, j, s, c: (k, 0, 0))
+
+    def out_spec(self, F):
+        return pl.BlockSpec((self.block_e, F), lambda k, j, s, c: (k, 0))
+
+
 def _gather_kernel(
     vb_starts_ref, vb_counts_ref, ids_ref, x_ref, out_ref, *,
     block_n, block_e, precision,
@@ -519,50 +616,13 @@ def _make_srg(num_rows, max_vblocks, block_e, block_n, interpret, precision,
     def impl(x, ids):
         E = ids.shape[0]
         F = x.shape[1]
-        E_pad = pl.cdiv(E, block_e) * block_e
-        N_pad = pl.cdiv(num_rows, block_n) * block_n
-        nb = N_pad // block_n
-        num_chunks = E_pad // block_e
-        ids_p = ids
-        if E_pad != E:
-            ids_p = jnp.pad(ids, (0, E_pad - E), constant_values=num_rows + 1)
-        x_p = x
-        if N_pad != x.shape[0]:
-            x_p = jnp.pad(x, ((0, N_pad - x.shape[0]), (0, 0)))
-        ids3d = ids_p.reshape(num_chunks, 1, block_e)
-        # per-chunk vertex-block span (ids sorted within each chunk):
-        # first/last element of the chunk, clamped into [0, nb)
-        firsts = jnp.clip(ids_p.reshape(num_chunks, block_e)[:, 0], 0,
-                          N_pad - 1)
-        lasts = jnp.clip(ids_p.reshape(num_chunks, block_e)[:, -1], 0,
-                         N_pad - 1)
-        vb_start = (firsts // block_n).astype(jnp.int32)
-        vb_counts = jnp.minimum(
-            (lasts // block_n).astype(jnp.int32) - vb_start + 1, max_vblocks
-        ).astype(jnp.int32)
-
-        def ids_index(k, j, starts, counts):
-            return (k, 0, 0)
-
-        def x_index(k, j, starts, counts):
-            # clamp past-count iterations onto the last valid block: Mosaic
-            # skips the DMA when consecutive steps map to the same block
-            return (
-                jnp.minimum(
-                    starts[k] + jnp.minimum(j, jnp.maximum(counts[k] - 1, 0)),
-                    nb - 1,
-                ),
-                0,
-            )
-
+        vs = _VBlockSchedule(ids, num_rows, E, block_e=block_e,
+                             block_n=block_n, max_vblocks=max_vblocks)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(num_chunks, max_vblocks),
-            in_specs=[
-                pl.BlockSpec((1, 1, block_e), ids_index),
-                pl.BlockSpec((block_n, F), x_index),
-            ],
-            out_specs=pl.BlockSpec((block_e, F), lambda k, j, s, c: (k, 0)),
+            grid=(vs.num_chunks, max_vblocks),
+            in_specs=[vs.ids_spec(), vs.vtx_spec(F)],
+            out_specs=vs.out_spec(F),
         )
         out = pl.pallas_call(
             functools.partial(
@@ -570,9 +630,9 @@ def _make_srg(num_rows, max_vblocks, block_e, block_n, interpret, precision,
                 precision=_precision(precision),
             ),
             grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct((E_pad, F), jnp.float32),
+            out_shape=jax.ShapeDtypeStruct((vs.E_pad, F), jnp.float32),
             interpret=interpret,
-        )(vb_start, vb_counts, ids3d, x_p)
+        )(vs.vb_start, vs.vb_counts, vs.ids3d, vs.pad_vertices(x))
         return out[:E].astype(x.dtype)
 
     @jax.custom_vjp
@@ -594,6 +654,108 @@ def _make_srg(num_rows, max_vblocks, block_e, block_n, interpret, precision,
 
     f.defvjp(fwd, bwd)
     return f
+
+
+def _fused_bwd_kernel(
+    vb_starts_ref, vb_counts_ref, ids_ref, data_ref, g_ref, bias_ref,
+    out_ref, g_acc, bias_acc, *, block_n, block_e, precision,
+):
+    """gd[e] = g[ids[e]] * 1[data[e] + bias[ids[e]] > 0] in ONE
+    chunk-major pass: the fused scatter's data-gradient with no [E, F]
+    HBM intermediates (no bias-rows take, no g-rows take, no act
+    materialization — the r4 composed bwd streamed all three). The
+    WEIGHTED fused op keeps the composed backward (it additionally needs
+    d_w, whose row-dot requires the very intermediates this kernel
+    avoids), so there is deliberately no edge-weight input here.
+
+    Chunk-major grid like :func:`_gather_kernel`; g and bias rows are
+    accumulated per vertex-block via one-hot matmuls (disjoint per edge,
+    so plain += is exact), and the activation mask is decided in f32 at
+    the last vertex block of the chunk's span — the same rounding story
+    as the forward kernel (operands rounded to the data dtype, compare
+    in f32)."""
+    k = pl.program_id(0)  # edge chunk (owns the resident out block)
+    j = pl.program_id(1)  # vertex-block iteration within the chunk's span
+
+    @pl.when(j == 0)
+    def _init():
+        # accumulate in f32 VMEM SCRATCH, not in the output: an f32
+        # [E, F] out would be an f32 HBM stream (the discipline the gd
+        # kernel exists to avoid) — out is written once, in the compute
+        # dtype, at the last step of the span
+        g_acc[...] = jnp.zeros_like(g_acc)
+        bias_acc[...] = jnp.zeros_like(bias_acc)
+
+    @pl.when(j < vb_counts_ref[k])
+    def _accumulate():
+        ids = ids_ref[0, 0]  # [block_e] int32 (global, sorted)
+        vb = vb_starts_ref[k] + j
+        rel2 = (ids - vb * block_n)[:, None]  # [block_e, 1] (2-D: Mosaic)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_e, block_n), 1)
+        onehot = jnp.where(
+            (cols == rel2) & (rel2 >= 0) & (rel2 < block_n), 1.0, 0.0
+        ).astype(g_ref.dtype)
+        g_acc[...] += jax.lax.dot_general(
+            onehot, g_ref[...],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=g_acc.dtype, precision=precision,
+        )
+        bias_acc[...] += jax.lax.dot_general(
+            onehot, bias_ref[...],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=bias_acc.dtype, precision=precision,
+        )
+
+    # runs AFTER this step's accumulation (kernel body is sequential), so
+    # the span's g/bias sums are complete exactly once per chunk
+    @pl.when(j == vb_counts_ref[k] - 1)
+    def _finish():
+        chunk = data_ref[0]  # [block_e, F]
+        pre = chunk.astype(jnp.float32) + bias_acc[...]
+        act = (pre > 0).astype(jnp.float32)
+        out_ref[...] = (g_acc[...] * act).astype(out_ref.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fused_bwd(num_rows, max_vblocks, block_e, block_n, interpret,
+                    precision):
+    """Builder for the (unweighted) fused scatter's data-gradient kernel
+    (see :func:`_fused_bwd_kernel`). Returns fn(data, g, bias, ids) ->
+    [E, F] gd in data's dtype."""
+
+    def impl(data, g, bias, ids):
+        E, F = data.shape
+        vs = _VBlockSchedule(ids, num_rows, E, block_e=block_e,
+                             block_n=block_n, max_vblocks=max_vblocks)
+        data3d = vs.pad_edges(data).reshape(vs.num_chunks, block_e, F)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(vs.num_chunks, max_vblocks),
+            in_specs=[
+                vs.ids_spec(),
+                pl.BlockSpec((1, block_e, F), lambda k, j, s, c: (k, 0, 0)),
+                vs.vtx_spec(F),
+                vs.vtx_spec(F),
+            ],
+            out_specs=vs.out_spec(F),
+            scratch_shapes=[
+                pltpu.VMEM((block_e, F), jnp.float32),  # g-rows acc
+                pltpu.VMEM((block_e, F), jnp.float32),  # bias-rows acc
+            ],
+        )
+        out = pl.pallas_call(
+            functools.partial(
+                _fused_bwd_kernel, block_n=block_n, block_e=block_e,
+                precision=_precision(precision),
+            ),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((vs.E_pad, F), data.dtype),
+            interpret=interpret,
+        )(vs.vb_start, vs.vb_counts, vs.ids3d, data3d,
+          vs.pad_vertices(g), vs.pad_vertices(bias))
+        return out[:E]
+
+    return impl
 
 
 def sorted_row_gather(
