@@ -240,6 +240,37 @@ def pallas_fused_selfcheck() -> bool:
         "fused-bwd-kernel-pair(grads,f32)", grads,
         np.concatenate([gd_want.ravel(), db_want.ravel()]), 2e-4,
     )
+
+    # bf16/default kernel-pair grads vs the COMPOSED backward (gather_mv=0
+    # disables the pair) at the SAME bf16 rounding — an f32 reference
+    # would differ by whole elements at ReLU-boundary mask flips. The
+    # bf16 variant is the one bf16 training actually runs; "a Mosaic
+    # acc-dtype bug is invisible to the f32 check alone" (r2).
+    def grads_bf16(gmv):
+        def lo(d, b):
+            out = sorted_segment_sum_bias_relu(
+                d, jnp.asarray(ids), b, N, max_chunks_per_block=mc,
+                block_e=be, block_n=bn, gather_mv=gmv, precision="default",
+            )
+            return (out.astype(jnp.float32) * jnp.asarray(tgt)).sum()
+
+        gd, db = jax.grad(lo, argnums=(0, 1))(
+            jnp.asarray(data, jnp.bfloat16), jnp.asarray(bias, jnp.bfloat16)
+        )
+        return jnp.concatenate(
+            [gd.astype(jnp.float32).ravel(), db.astype(jnp.float32).ravel()]
+        )
+
+    try:
+        ref_bf16 = np.asarray(grads_bf16(0))
+    except Exception as e:  # composed-reference failure must veto, not crash
+        log(f"self-check fused-bwd-kernel-pair(grads,bf16) reference "
+            f"raised {type(e).__name__}: {e}")
+        return False
+    ok &= _check_one(
+        "fused-bwd-kernel-pair(grads,bf16)", lambda: grads_bf16(mv),
+        ref_bf16, 5e-2,
+    )
     return ok
 
 
@@ -593,6 +624,13 @@ def _init_backend_fail_fast():
     import jax
 
     want = _expected_platform()
+    if not want:
+        # smoke / explicitly non-TPU: re-pin the requested platform via
+        # jax.config — the axon sitecustomize re-pins jax_platforms at
+        # startup, so the env var alone would leave the child dialing the
+        # (possibly wedged) lease. Honor an explicit non-cpu request.
+        jax.config.update(
+            "jax_platforms", os.environ.get("JAX_PLATFORMS") or "cpu")
     last = None
     for attempt in (1, 2):
         try:
@@ -837,11 +875,19 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
     want = _expected_platform()
     check = (f"assert jax.default_backend() == '{want}', "
              f"jax.default_backend()" if want else "pass")
+    # non-TPU runs (smoke / explicit JAX_PLATFORMS=cpu) must pin the
+    # platform via jax.config INSIDE the probe: the baked axon
+    # sitecustomize re-pins jax_platforms at interpreter startup, so the
+    # env var alone leaves the probe dialing the (possibly wedged) TPU
+    # lease a CPU smoke never needs
+    pin = ("" if want else
+           "import os; jax.config.update('jax_platforms', "
+           "os.environ.get('JAX_PLATFORMS') or 'cpu'); ")
     # the probe must run a real device op + scalar fetch, not just
     # init: a wedged lease can init PJRT fine and hang the first
     # dispatch (the established wedge probe from r1+r2)
     probe = [sys.executable, "-c",
-             "import jax, jax.numpy as jnp; jax.devices(); "
+             f"import jax, jax.numpy as jnp; {pin}jax.devices(); "
              f"{check}; float(jnp.ones((8, 128)).sum())"]
     phase1_end = deadline - 0.5 * budget
     attempt = 0

@@ -482,7 +482,12 @@ def sorted_segment_sum_bias_relu(
     block_e: int = 512,
     block_n: int = 256,
     interpret: bool = False,
-    gather_mv: int = 0,  # see sorted_segment_sum
+    gather_mv: int = 0,  # vblock-span hint (plan.gather_mv). >0 selects
+    # the UNWEIGHTED op's Pallas backward KERNEL PAIR on TPU
+    # (_fused_bwd_kernel gd + epilogue="act" d_bias — no config flag
+    # involved; the fused kill switch gates at the dispatch point). In
+    # the composed/weighted backward it additionally lets the cotangent
+    # gather use sorted_row_gather under DGRAPH_TPU_PALLAS_GATHER.
     precision: str = "default",
 ) -> jax.Array:
     """out[v] = Σ_{e: ids[e]=v} w[e] * relu(data[e] + bias[v]) without ever

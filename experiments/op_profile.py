@@ -112,17 +112,26 @@ def main(cfg: Config):
 
     timed("grad_scatter_dst", lambda cc: jax.grad(s_loss)(x_e, cc, "dst"))
 
-    # the FUSED bias+relu aggregation (the op the GCN fwd actually runs)
+    # the FUSED bias+relu aggregation (the op the GCN fwd actually runs).
+    # UNWEIGHTED first: that is the model's path, and its backward is the
+    # r4c kernel pair (chunk-major gd kernel + epilogue="act" reduction);
+    # the weighted variant keeps the composed backward, so its grad row
+    # measures a different program.
     ew = jax.random.uniform(jax.random.key(3), (Ep,), dt)
     timed("fused_scatter_bias_relu", lambda cc: coll.scatter_bias_relu(
-        x_e + c(cc), x_n, plan, "dst", None, edge_weight=ew))
+        x_e + c(cc), x_n, plan, "dst", None))
 
-    def f_loss(xe, cc):
+    def f_loss(xe, cc, w):
         out = coll.scatter_bias_relu(xe + c(cc), x_n, plan, "dst", None,
-                                     edge_weight=ew)
+                                     edge_weight=w)
         return (out.astype(jnp.float32) ** 2).sum()
 
-    timed("grad_fused_scatter", lambda cc: jax.grad(f_loss)(x_e, cc))
+    timed("grad_fused_scatter", lambda cc: jax.grad(f_loss)(x_e, cc, None))
+    timed("fused_scatter_bias_relu_weighted",
+          lambda cc: coll.scatter_bias_relu(
+              x_e + c(cc), x_n, plan, "dst", None, edge_weight=ew))
+    timed("grad_fused_scatter_weighted",
+          lambda cc: jax.grad(f_loss)(x_e, cc, ew))
 
     # chunk-width variants: the models invoke every edge op through the
     # feature-chunked pipeline (<= gather_col_block wide), so the epoch is
@@ -134,7 +143,15 @@ def main(cfg: Config):
               lambda cc: coll.gather(x_nc + c(cc), plan, "src", None))
         timed(f"fused_scatter_bias_relu_w{cw}",
               lambda cc: coll.scatter_bias_relu(
-                  x_ec + c(cc), x_nc, plan, "dst", None, edge_weight=ew))
+                  x_ec + c(cc), x_nc, plan, "dst", None))
+
+        def fc_loss(xe, cc):
+            out = coll.scatter_bias_relu(xe + c(cc), x_nc, plan, "dst",
+                                         None)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        timed(f"grad_fused_scatter_w{cw}",
+              lambda cc: jax.grad(fc_loss)(x_ec, cc))
 
     # whole-layer anchors: one GraphConvLayer forward and its grad — the
     # per-op sum above must land within ~20% of 2x these (2-layer GCN) or
