@@ -92,6 +92,23 @@ done
 python scripts/adopt_sweep.py logs/kernel_benchmarks.jsonl > logs/sweep_winners.txt 2>&1 || true
 commit_stage sweep_winners logs/sweep_winners.txt
 
+# 7b. NARROW widths (F = num_heads scale): decides whether GAT/RGAT's
+#     [E, heads] attention-softmax ops get the Pallas route (r4c audit;
+#     the XLA scatter there is per-row, so narrow may cost like wide).
+#     Also the first on-chip Mosaic compile of the kernels at F < 8 —
+#     split per (dtype, F) so one Mosaic crash loses a quarter, and
+#     logged to a SEPARATE jsonl so the single-tile narrow rows cannot
+#     vote in adopt_sweep's tile consensus on a queue re-run.
+for dt in float32 bfloat16; do
+  for F in 2 8; do
+    if run_stage "sweep_narrow_${dt}_${F}" bash -c "set -o pipefail; \
+      timeout 900 python experiments/kernel_benchmarks.py --dtypes $dt \
+      --feat_dims $F --out logs/kernel_narrow.jsonl 2>&1 | tail -5"; then
+      commit_stage "sweep_narrow_${dt}_${F}" logs/kernel_narrow.jsonl
+    fi
+  done
+done
+
 # 8. flash-attention A/B at seq 8192 (original stage 5)
 for fl in 0 1; do
   run_stage "lm flash=$fl" bash -c "set -o pipefail; DGRAPH_TPU_FLASH_ATTN=$fl timeout 1200 python experiments/long_context_lm.py --seq_len 8192 --steps 30 --world_size 1 --latent 256 --num_heads 2 --attn_impl ulysses --log_path logs/lm_flash${fl}_onchip.jsonl 2>&1 | tail -2" || break
