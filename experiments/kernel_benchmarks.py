@@ -48,15 +48,30 @@ class Config:
     skip_ops: str = ""
 
 
-def _bench(op, arg, *, reps: int, n_long: int):
+def _bench(op, arg, *, reps: int, n_long: int, label: str = "?"):
     """One op's in-jit scan timing — delegates to the shared protocol
     (``dgraph_tpu.utils.timing.timed_scan_ms``; ``salt_input`` keeps bf16
-    inputs bf16)."""
+    inputs bf16). A per-op failure (e.g. a Mosaic compile crash at an
+    untried width) records NaN instead of killing the remaining ops —
+    during a scarce lease window every surviving row counts
+    (adopt_sweep filters non-finite ms, so NaN rows cannot win a tile)."""
+    import sys
+    import traceback
+
     from dgraph_tpu.utils.timing import salt_input, timed_scan_ms
 
-    t = timed_scan_ms(
-        lambda s: op(salt_input(arg, s)), reps=reps, n_long=n_long
-    )
+    try:
+        t = timed_scan_ms(
+            lambda s: op(salt_input(arg, s)), reps=reps, n_long=n_long
+        )
+    except Exception as e:  # noqa: BLE001
+        print(f"bench op {label} raised {type(e).__name__}: "
+              f"{str(e).splitlines()[0] if str(e) else ''}",
+              file=sys.stderr)
+        # negative limit = innermost frames (the Mosaic/pallas one that
+        # names the failed lowering); a positive limit shows only _bench
+        traceback.print_exc(limit=-5, file=sys.stderr)
+        return float("nan")
     return t if t is not None else float("nan")  # NaN survives round()
 
 
@@ -105,17 +120,19 @@ def main(cfg: Config):
         bench = partial(_bench, reps=cfg.reps, n_long=cfg.n_long)
 
         if "gather_plain" not in skipped:
-            t = bench(lambda a: a[idx], x)
+            t = bench(lambda a: a[idx], x, label=f"gather_plain/{dname}/F{F}")
             record(op="gather_plain", F=F, dtype=dname, ms=round(t, 3),
                    gbps=round(E_pad * F * b / t / 1e6, 1))
         if "gather_col_split" not in skipped:
-            t = bench(lambda a: local_ops.row_take(a, idx, col_block=128), x)
+            t = bench(lambda a: local_ops.row_take(a, idx, col_block=128), x,
+                      label=f"gather_col_split/{dname}/F{F}")
             record(op="gather_col_split", F=F, dtype=dname, ms=round(t, 3),
                    gbps=round(E_pad * F * b / t / 1e6, 1))
         # sorted-id gathers: the owner-side case (XLA vs the Pallas
         # transpose kernel — the A/B that decides use_pallas_gather)
         if "gather_sorted_xla" not in skipped:
-            t = bench(lambda a: local_ops.row_take(a, sids, col_block=128), x)
+            t = bench(lambda a: local_ops.row_take(a, sids, col_block=128), x,
+                      label=f"gather_sorted_xla/{dname}/F{F}")
             record(op="gather_sorted_xla", F=F, dtype=dname, ms=round(t, 3),
                    gbps=round(E_pad * F * b / t / 1e6, 1))
         if cfg.pallas and on_tpu:
@@ -134,6 +151,7 @@ def main(cfg: Config):
                         precision=prec0,
                     ),
                     x,
+                    label=f"gather_sorted_pallas/{dname}/F{F}",
                 )
                 record(op="gather_sorted_pallas", F=F, dtype=dname, mv=mv,
                        ms=round(t, 3),
@@ -141,7 +159,8 @@ def main(cfg: Config):
         if "segment_sum_xla" not in skipped:
             t = bench(
                 lambda a: local_ops.segment_sum(
-                    a, sids, N, indices_are_sorted=True), ed
+                    a, sids, N, indices_are_sorted=True), ed,
+                label=f"segment_sum_xla/{dname}/F{F}",
             )
             record(op="segment_sum_xla", F=F, dtype=dname, ms=round(t, 3),
                    gbps=round(E_pad * F * b / t / 1e6, 1))
@@ -169,6 +188,8 @@ def main(cfg: Config):
                             block_e=be, block_n=bn, precision=prec,
                         ),
                         ed,
+                        label=(f"segment_sum_pallas_{prec}/{dname}"
+                               f"/F{F}/be{be}bn{bn}"),
                     )
                     record(op=f"segment_sum_pallas_{prec}", F=F, dtype=dname,
                            block_e=be, block_n=bn, mc=mc, ms=round(t, 3),
@@ -186,6 +207,8 @@ def main(cfg: Config):
                             a, sids, max_vblocks=mv, block_e=be, block_n=bn,
                             scatter_mc=mc, precision=prec0),
                         x,
+                        label=(f"gather_sorted_pallas_sweep/{dname}"
+                               f"/F{F}/be{be}bn{bn}"),
                     )
                     record(op="gather_sorted_pallas_sweep", F=F, dtype=dname,
                            block_e=be, block_n=bn, mv=mv, ms=round(t, 3),

@@ -32,7 +32,11 @@ def main(path: str = "logs/kernel_benchmarks.jsonl") -> None:
     sweep = defaultdict(dict)   # (op, dtype, F) -> {(be, bn): ms}
     flat = {}                   # (op, dtype, F) -> ms (non-sweep rows)
     for r in rows:
-        if r.get("ms") is None:
+        ms = r.get("ms")
+        # NaN rows mark per-op failures (a crashed compile, a noisy
+        # tunnel); min() over a dict containing NaN can crown the crashed
+        # tile as WINNER (every x < nan is False), so drop non-finite
+        if ms is None or ms != ms:
             continue
         k = key(r, "op", "dtype", "F")
         if "block_e" in r:
