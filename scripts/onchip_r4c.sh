@@ -110,10 +110,16 @@ for dt in float32 bfloat16; do
 done
 
 # 8. flash-attention A/B at seq 8192 (original stage 5)
+# distinct bf16 paths: r3/r4 queued the same A/B in f32 to
+# logs/lm_flash{0,1}_onchip.jsonl — appending mixed-dtype rows to those
+# would make the committed artifact unreadable
+flash_ran=0
 for fl in 0 1; do
-  run_stage "lm flash=$fl" bash -c "set -o pipefail; DGRAPH_TPU_FLASH_ATTN=$fl timeout 1200 python experiments/long_context_lm.py --seq_len 8192 --steps 30 --world_size 1 --latent 256 --num_heads 2 --attn_impl ulysses --log_path logs/lm_flash${fl}_onchip.jsonl 2>&1 | tail -2" || break
+  run_stage "lm flash=$fl" bash -c "set -o pipefail; DGRAPH_TPU_FLASH_ATTN=$fl DGRAPH_TPU_COMPUTE_DTYPE=bfloat16 timeout 1200 python experiments/long_context_lm.py --seq_len 8192 --steps 30 --world_size 1 --latent 256 --num_heads 2 --attn_impl ulysses --log_path logs/lm_flash${fl}_bf16_onchip.jsonl 2>&1 | tail -2" && flash_ran=1 || break
 done
-commit_stage flash_ab logs/lm_flash0_onchip.jsonl logs/lm_flash1_onchip.jsonl
+if [ "$flash_ran" = 1 ]; then
+  commit_stage flash_ab logs/lm_flash0_bf16_onchip.jsonl logs/lm_flash1_bf16_onchip.jsonl
+fi
 
 # 8b. First on-chip RGAT record (arxiv-scale synthetic MAG, bf16): also
 #     measures the narrow [E, heads] attention-softmax XLA scatters the
