@@ -243,11 +243,18 @@ class DistributedOGBDataset:
         os.makedirs(cache_dir, exist_ok=True)
         # every knob that changes the built graph participates in the cache
         # key — a partial key would silently reuse a graph built with
-        # different normalization/padding/source
+        # different normalization/padding/source. The pickle embeds a
+        # built EdgePlan, so the plan FORMAT version (and the block size
+        # that now shapes e_pad) must key it too: a warm v5 cache would
+        # otherwise keep serving unaligned plans forever.
         import hashlib
 
+        from dgraph_tpu.plan import SCATTER_BLOCK_E
+        from dgraph_tpu.train.checkpoint import PLAN_FORMAT_VERSION
+
         opts = hashlib.sha256(
-            repr((pad_multiple, symmetrize, add_symmetric_norm, data_path)).encode()
+            repr((pad_multiple, symmetrize, add_symmetric_norm, data_path,
+                  PLAN_FORMAT_VERSION, SCATTER_BLOCK_E)).encode()
         ).hexdigest()[:10]
         cache = os.path.join(
             cache_dir, f"{name}_w{world_size}_{partition_method}_{opts}.pkl"

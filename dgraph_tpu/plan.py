@@ -553,6 +553,24 @@ def _pad_to(x: int, multiple: int) -> int:
     return max(-(-x // multiple) * multiple, multiple)
 
 
+def _edge_pad_align(e_max: int, pad_multiple: int) -> int:
+    """Alignment for the per-rank edge padding (SHARED by the numpy and
+    native builders — a divergence would give the two paths different
+    e_pad for the same graph). Once the plan reaches kernel scale, e_pad
+    aligns to the Pallas scatter block too: a non-block_e-multiple e_pad
+    makes every kernel invocation re-pad its [E, F] operand — a full HBM
+    copy per pallas_call per step (r4c finding; the bench plan's 2332544
+    was 896 past a 1024 block). Cost: <= block_e-1 extra masked edge
+    slots. Sub-block plans keep the caller's pad_multiple (the in-op pad
+    there is negligible, and hand-analyzed test plans pin exact tiny
+    shapes)."""
+    import math
+
+    if e_max >= SCATTER_BLOCK_E:
+        return math.lcm(pad_multiple, SCATTER_BLOCK_E)
+    return pad_multiple
+
+
 def build_edge_plan(
     edge_index: np.ndarray,
     src_partition: np.ndarray,
@@ -641,7 +659,9 @@ def build_edge_plan(
     else:
         order = np.argsort(owner, kind="stable")
     e_counts = np.bincount(owner, minlength=W).astype(np.int64)
-    E_pad = e_pad if e_pad is not None else _pad_to(int(e_counts.max(initial=1)), pad_multiple)
+    _e_max = int(e_counts.max(initial=1))
+    E_pad = e_pad if e_pad is not None else _pad_to(
+        _e_max, _edge_pad_align(_e_max, pad_multiple))
     if int(e_counts.max(initial=0)) > E_pad:
         raise ValueError(f"e_pad={E_pad} < max per-rank edges {int(e_counts.max())}")
     e_starts = np.concatenate([[0], np.cumsum(e_counts)])
@@ -871,7 +891,8 @@ def _build_edge_plan_native(
         src, dst, src_partition, dst_partition, src_offsets, dst_offsets,
         W, edge_owner,
     )
-    E_pad = e_pad if e_pad is not None else _pad_to(core.e_max, pad_multiple)
+    E_pad = e_pad if e_pad is not None else _pad_to(
+        core.e_max, _edge_pad_align(core.e_max, pad_multiple))
     if core.e_max > E_pad:
         raise ValueError(f"e_pad={E_pad} < max per-rank edges {core.e_max}")
     S_pad = s_pad if s_pad is not None else _pad_to(max(core.s_max, 1), pad_multiple)

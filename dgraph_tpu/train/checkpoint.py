@@ -95,7 +95,9 @@ def checkpoint_keys(ckpt_dir: str, step: Optional[int] = None):
 # Bump whenever EdgePlan's fields/defaults change shape or meaning: stale
 # cache pickles must REBUILD, not silently inherit new class defaults for
 # fields they were never built with (e.g. scatter_block_e).
-PLAN_FORMAT_VERSION = 5  # v5: gather_mv (sorted-row-gather vblock hint);
+PLAN_FORMAT_VERSION = 6  # v6: e_pad aligned to lcm(pad_multiple,
+# SCATTER_BLOCK_E) so pallas operands need no per-call re-pad copy;
+# v5: gather_mv (sorted-row-gather vblock hint);
 # v4: halo-side sorted route (halo_sort_perm / halo_sorted_ids /
 # halo_sort_mc); v3: scatter_block_e default 512 -> 1024
 
