@@ -58,6 +58,20 @@ def pallas_fused_enabled() -> bool:
         return use_pallas_fused
     return pallas_scatter_enabled()
 
+
+# The fused-BACKWARD kernel pair (chunk-major gd + epilogue="act" d_bias)
+# inside the fused op's VJP. Tri-state; None = engage whenever the fused
+# op itself runs. A Mosaic regression hitting only the bwd kernels can be
+# disabled here without vetoing the whole fused op (ADVICE r4): the
+# composed bwd fallback stays available as the A/B control.
+use_pallas_fused_bwd: bool | None = _env_flag("DGRAPH_TPU_PALLAS_FUSED_BWD", None)
+
+
+def pallas_fused_bwd_enabled() -> bool:
+    if use_pallas_fused_bwd is not None:
+        return use_pallas_fused_bwd
+    return True
+
 # Mosaic flash-attention kernel for the Ulysses full-sequence per-head
 # attention (parallel/sequence.py). Tri-state like the scatter kernels:
 # None = auto (ON on TPU when shapes qualify), env DGRAPH_TPU_FLASH_ATTN

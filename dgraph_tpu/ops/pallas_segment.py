@@ -406,8 +406,13 @@ def _make_ssbr(num_segments, max_chunks_per_block, block_e, block_n, interpret,
         # ONE vblock-major pass (epilogue="act"). Engages when the plan
         # carried the vblock-span hint (gather_mv) and the kernels can run
         # (TPU, or interpret mode for tests); the fused kill switch
-        # already gated entry into this op at the dispatch point.
+        # already gated entry into this op at the dispatch point, and
+        # config.pallas_fused_bwd_enabled() (trace-time read) disables
+        # just this pair for debugging/A-B without losing the fused fwd.
+        from dgraph_tpu import config as _config
+
         if (not has_weight and gather_mv > 0
+                and _config.pallas_fused_bwd_enabled()
                 and (interpret or jax.default_backend() == "tpu")):
             gd = _make_fused_bwd(
                 num_segments, gather_mv, block_e, block_n, interpret,
@@ -484,8 +489,10 @@ def sorted_segment_sum_bias_relu(
     interpret: bool = False,
     gather_mv: int = 0,  # vblock-span hint (plan.gather_mv). >0 selects
     # the UNWEIGHTED op's Pallas backward KERNEL PAIR on TPU
-    # (_fused_bwd_kernel gd + epilogue="act" d_bias — no config flag
-    # involved; the fused kill switch gates at the dispatch point). In
+    # (_fused_bwd_kernel gd + epilogue="act" d_bias), additionally gated
+    # by config.pallas_fused_bwd_enabled() read at trace time
+    # (DGRAPH_TPU_PALLAS_FUSED_BWD — the pair's own kill switch; the
+    # fused op as a whole still gates at the dispatch point). In
     # the composed/weighted backward it additionally lets the cotangent
     # gather use sorted_row_gather under DGRAPH_TPU_PALLAS_GATHER.
     precision: str = "default",

@@ -87,6 +87,14 @@ def main(cfg: Config):
         os.makedirs(os.path.dirname(cfg.out) or ".", exist_ok=True)
 
     def record(**kw):
+        # non-finite ms/gbps (per-op failure) become null: json.dumps
+        # would emit a bare NaN token, which Python's json re-reads but
+        # strict parsers (jq) reject on the streamed jsonl (ADVICE r4).
+        # adopt_sweep already drops None rows.
+        for k in ("ms", "gbps"):
+            v = kw.get(k)
+            if isinstance(v, float) and not np.isfinite(v):
+                kw[k] = None
         kw["ts"] = time.time()
         line = json.dumps(kw)
         print(line)

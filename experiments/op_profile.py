@@ -117,16 +117,21 @@ def main(cfg: Config):
     # r4c kernel pair (chunk-major gd kernel + epilogue="act" reduction);
     # the weighted variant keeps the composed backward, so its grad row
     # measures a different program.
+    # NOTE label semantics (ADVICE r4): before r4c these two rows measured
+    # the WEIGHTED op; jsonl rows from r4 logs under the same names are a
+    # different program. The "_unweighted" suffix makes the break explicit.
     ew = jax.random.uniform(jax.random.key(3), (Ep,), dt)
-    timed("fused_scatter_bias_relu", lambda cc: coll.scatter_bias_relu(
-        x_e + c(cc), x_n, plan, "dst", None))
+    timed("fused_scatter_bias_relu_unweighted",
+          lambda cc: coll.scatter_bias_relu(
+              x_e + c(cc), x_n, plan, "dst", None))
 
     def f_loss(xe, cc, w):
         out = coll.scatter_bias_relu(xe + c(cc), x_n, plan, "dst", None,
                                      edge_weight=w)
         return (out.astype(jnp.float32) ** 2).sum()
 
-    timed("grad_fused_scatter", lambda cc: jax.grad(f_loss)(x_e, cc, None))
+    timed("grad_fused_scatter_unweighted",
+          lambda cc: jax.grad(f_loss)(x_e, cc, None))
     timed("fused_scatter_bias_relu_weighted",
           lambda cc: coll.scatter_bias_relu(
               x_e + c(cc), x_n, plan, "dst", None, edge_weight=ew))

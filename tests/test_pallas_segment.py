@@ -290,6 +290,65 @@ class TestFusedBiasRelu:
                 rtol=0.02, atol=0.02, err_msg=name,
             )
 
+    def test_fused_bwd_kill_switch_routes_to_composed(self):
+        """With use_pallas_fused_bwd=False the VJP must bypass the kernel
+        pair even when gather_mv>0 (ADVICE r4: the pair needs its own
+        disable for Mosaic-regression debugging), and grads must match the
+        enabled path. The flag is read at trace time, so flipping it here
+        exercises the branch without env vars."""
+        from dgraph_tpu import config
+        from dgraph_tpu.ops.pallas_segment import (
+            max_chunks_hint,
+            max_vblocks_hint,
+            sorted_segment_sum_bias_relu,
+        )
+
+        ids, data, bias, _ = self._case(9, E=512, N=128, F=8)
+        N = bias.shape[0]
+        mc = max_chunks_hint(ids, N)
+        mv = max_vblocks_hint(ids, N)
+        tgt = jnp.asarray(
+            np.random.default_rng(11).standard_normal((N, 8)).astype(np.float32)
+        )
+
+        def loss(d, b):
+            out = sorted_segment_sum_bias_relu(
+                d, jnp.asarray(ids), b, N,
+                max_chunks_per_block=mc, gather_mv=mv, interpret=True,
+            )
+            return (out.astype(jnp.float32) * tgt).sum()
+
+        # the pair and the composed bwd agree numerically by design, so a
+        # silently-ignored flag would still pass an allclose — count the
+        # kernel-pair factory's invocations to prove the ROUTING flips
+        from dgraph_tpu.ops import pallas_segment as ps
+
+        real_make = ps._make_fused_bwd
+        calls = []
+
+        def counting_make(*a, **kw):
+            calls.append(1)
+            return real_make(*a, **kw)
+
+        args = (jnp.asarray(data), jnp.asarray(bias))
+        old_flag = config.use_pallas_fused_bwd
+        ps._make_fused_bwd = counting_make
+        try:
+            g_on = jax.grad(loss, argnums=(0, 1))(*args)
+            assert calls, "kernel pair did not engage with the flag on"
+            calls.clear()
+            config.set_flags(use_pallas_fused_bwd=False)
+            g_off = jax.grad(loss, argnums=(0, 1))(*args)
+            assert not calls, "kill switch ignored: kernel pair still ran"
+        finally:
+            ps._make_fused_bwd = real_make
+            config.set_flags(use_pallas_fused_bwd=old_flag)
+        for a, b, name in zip(g_on, g_off, ["d_data", "d_bias"]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+                err_msg=name,
+            )
+
     def test_collectives_fallback_equals_composed(self):
         """Off-TPU, scatter_bias_relu must equal gather+relu+scatter_sum."""
         from dgraph_tpu.comm import collectives as coll
