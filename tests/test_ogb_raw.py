@@ -1,0 +1,210 @@
+"""Byte-real OGB raw-download fixtures through the real-ingestion branch.
+
+VERDICT r4 #7: the npz/stub paths were tested but nothing would catch a
+format drift the day egress appears. These tests write tiny datasets in the
+OFFICIAL on-disk layout (same file names, gzip csv bytes written the way
+ogb's own pandas pipeline writes them, binary npz for papers100M) and drive
+``load_ogb_arrays``'s raw-download branch — the branch real downloads will
+take in this pip-less environment — through parsing, postprocessing, and a
+full training run. No ogb stub is injected anywhere here.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.data import ogb_raw, ogbn
+
+
+def _toy(V=60, E=240, F=6, C=4, seed=0):
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(V)
+    return {
+        "edge_index": rng.integers(0, V, (2, E)).astype(np.int64),
+        "node_feat": rng.normal(size=(V, F)).astype(np.float32).round(4),
+        "labels": rng.integers(0, C, V).astype(np.int64),
+        "split_idx": {
+            "train": np.sort(perm[: V // 2]).astype(np.int64),
+            "valid": np.sort(perm[V // 2 : 3 * V // 4]).astype(np.int64),
+            "test": np.sort(perm[3 * V // 4 :]).astype(np.int64),
+        },
+    }
+
+
+def test_ogb_package_really_absent():
+    """The point of the suite: the raw branch runs because ogb is NOT
+    importable. If ogb ever appears in the image, the package branch takes
+    over and these fixtures stop covering egress-day ingestion — re-point
+    them at the package path then."""
+    with pytest.raises(ImportError):
+        import ogb  # noqa: F401
+
+
+def test_arxiv_csv_layout_roundtrips_exactly(tmp_path):
+    t = _toy()
+    ogb_raw.write_node_pred_raw(
+        str(tmp_path), "ogbn-arxiv",
+        edge_index=t["edge_index"], labels=t["labels"],
+        split_idx=t["split_idx"], node_feat=t["node_feat"],
+    )
+    # layout spot-checks: the exact artifact names the download ships
+    base = tmp_path / "ogbn_arxiv"
+    for rel in (
+        "raw/edge.csv.gz", "raw/node-feat.csv.gz", "raw/node-label.csv.gz",
+        "raw/num-node-list.csv.gz", "raw/num-edge-list.csv.gz",
+        "split/time/train.csv.gz", "split/time/valid.csv.gz",
+        "split/time/test.csv.gz",
+    ):
+        assert (base / rel).exists(), rel
+
+    arrs = ogbn.load_ogb_arrays("ogbn-arxiv", root=str(tmp_path))
+    np.testing.assert_array_equal(arrs["edge_index"], t["edge_index"])
+    np.testing.assert_array_equal(arrs["features"], t["node_feat"])
+    np.testing.assert_array_equal(arrs["labels"], t["labels"])
+    assert arrs["num_nodes"] == 60
+    for split in ("train", "valid", "test"):
+        got = np.nonzero(arrs[split + "_mask"])[0]
+        np.testing.assert_array_equal(got, t["split_idx"][split])
+
+
+def test_products_doubles_edges_like_master_csv(tmp_path):
+    """ogbn-products ships single-direction edges; ogb's loader doubles
+    them (master.csv add_inverse_edge) — the raw reader must too."""
+    t = _toy(seed=1)
+    ogb_raw.write_node_pred_raw(
+        str(tmp_path), "ogbn-products",
+        edge_index=t["edge_index"], labels=t["labels"],
+        split_idx=t["split_idx"], node_feat=t["node_feat"],
+    )
+    assert (tmp_path / "ogbn_products/split/sales_ranking/train.csv.gz").exists()
+    arrs = ogbn.load_ogb_arrays("ogbn-products", root=str(tmp_path))
+    E = t["edge_index"].shape[1]
+    assert arrs["edge_index"].shape == (2, 2 * E)
+    np.testing.assert_array_equal(arrs["edge_index"][:, :E], t["edge_index"])
+    np.testing.assert_array_equal(
+        arrs["edge_index"][:, E:], t["edge_index"][::-1]
+    )
+
+
+def test_proteins_species_features_and_multilabel(tmp_path):
+    """proteins: no node-feat file, node_species extra file, [V, C] 0/1
+    float labels, 8-dim edge features, inverse-edge doubling."""
+    V, E, C = 40, 160, 5
+    rng = np.random.default_rng(2)
+    t = _toy(V=V, E=E, seed=2)
+    species = rng.choice([3702, 4932, 9606], V).astype(np.int64)
+    labels = rng.integers(0, 2, (V, C)).astype(np.int64)
+    edge_feat = rng.uniform(size=(E, 8)).astype(np.float32).round(4)
+    ogb_raw.write_node_pred_raw(
+        str(tmp_path), "ogbn-proteins",
+        edge_index=t["edge_index"], labels=labels,
+        split_idx=t["split_idx"], node_species=species, edge_feat=edge_feat,
+    )
+    arrs = ogbn.load_ogb_arrays("ogbn-proteins", root=str(tmp_path))
+    # features = species one-hot + log1p(out-degree on the DOUBLED graph)
+    n_species = len(np.unique(species))
+    assert arrs["features"].shape == (V, n_species + 1)
+    doubled = np.concatenate([t["edge_index"], t["edge_index"][::-1]], axis=1)
+    deg = np.bincount(doubled[0], minlength=V).astype(np.float32)
+    np.testing.assert_allclose(arrs["features"][:, -1], np.log1p(deg))
+    assert arrs["labels"].shape == (V, C)
+    assert arrs["labels"].dtype == np.float32
+    np.testing.assert_array_equal(arrs["labels"], labels.astype(np.float32))
+
+
+def test_papers100m_binary_layout_and_nan_labels(tmp_path):
+    """papers100M ships raw/data.npz + raw/node-label.npz; unlabeled nodes
+    are NaN and must come back as class 0 outside every split mask."""
+    V = 50
+    t = _toy(V=V, E=200, seed=3)
+    labels = t["labels"].astype(np.float32)
+    unlabeled = np.setdiff1d(
+        np.arange(V), np.concatenate(list(t["split_idx"].values()))
+    )
+    labels[unlabeled] = np.nan
+    ogb_raw.write_node_pred_raw(
+        str(tmp_path), "ogbn-papers100M",
+        edge_index=t["edge_index"], labels=labels,
+        split_idx=t["split_idx"], node_feat=t["node_feat"],
+    )
+    raw = tmp_path / "ogbn_papers100M/raw"
+    assert (raw / "data.npz").exists() and (raw / "node-label.npz").exists()
+    assert not (raw / "edge.csv.gz").exists()
+    arrs = ogbn.load_ogb_arrays("ogbn-papers100M", root=str(tmp_path))
+    assert arrs["labels"].dtype == np.int32
+    np.testing.assert_array_equal(arrs["labels"][unlabeled], 0)
+    lab = np.nonzero(~np.isnan(labels))[0]
+    np.testing.assert_array_equal(
+        arrs["labels"][lab], t["labels"][lab].astype(np.int32)
+    )
+
+
+def test_split_dict_pt_short_circuit(tmp_path):
+    """Newer ogb releases ship split/{type}/split_dict.pt; it must win over
+    the csv files when present (torch.save zip format, as ogb writes it)."""
+    import torch
+
+    t = _toy(seed=4)
+    ogb_raw.write_node_pred_raw(
+        str(tmp_path), "ogbn-arxiv",
+        edge_index=t["edge_index"], labels=t["labels"],
+        split_idx=t["split_idx"], node_feat=t["node_feat"],
+    )
+    other = {k: v[: len(v) // 2].copy() for k, v in t["split_idx"].items()}
+    torch.save(
+        other, str(tmp_path / "ogbn_arxiv/split/time/split_dict.pt")
+    )
+    got = ogb_raw.read_split(str(tmp_path), "ogbn-arxiv")
+    for k in ("train", "valid", "test"):
+        np.testing.assert_array_equal(got[k], other[k])
+
+
+def test_missing_raw_layout_raises_with_recipe(tmp_path):
+    with pytest.raises(ImportError, match="raw download layout"):
+        ogbn.load_ogb_arrays("ogbn-arxiv", root=str(tmp_path / "empty"))
+
+
+def test_raw_fixture_trains_end_to_end(tmp_path, monkeypatch):
+    """The full egress-day path: official raw layout on disk -> experiment
+    CLI with --data.ogb_name + --data.root -> partitioned training on the
+    virtual mesh. Learnable SBM arrays so the run is a real training."""
+    from dgraph_tpu.data.synthetic import sbm_classification_graph
+
+    data = sbm_classification_graph(
+        num_nodes=400, num_classes=4, feat_dim=8, avg_degree=8.0,
+        homophily=0.85, seed=5,
+    )
+    masks = data["masks"]
+    split_idx = {
+        "train": np.nonzero(masks["train"])[0].astype(np.int64),
+        "valid": np.nonzero(masks["val"])[0].astype(np.int64),
+        "test": np.nonzero(masks["test"])[0].astype(np.int64),
+    }
+    ogb_raw.write_node_pred_raw(
+        str(tmp_path), "ogbn-arxiv",
+        edge_index=np.asarray(data["edge_index"], np.int64),
+        labels=np.asarray(data["labels"], np.int64),
+        split_idx=split_idx,
+        node_feat=np.asarray(data["features"], np.float32),
+    )
+
+    from experiments.ogb_gcn import Config, DataConfig, main
+
+    monkeypatch.chdir(tmp_path)  # logs/ lands in tmp
+    cfg = Config(
+        epochs=3, hidden=16, world_size=0,  # 0 = all (the conftest's 8)
+        log_path=str(tmp_path / "log.jsonl"),
+        data=DataConfig(ogb_name="ogbn-arxiv", root=str(tmp_path)),
+    )
+    main(cfg)
+    import json
+
+    rows = [
+        json.loads(l)
+        for l in open(tmp_path / "log.jsonl")
+        if l.strip() and not l.startswith("#")
+    ]
+    assert any("test_acc" in r for r in rows)
+    losses = [r["loss"] for r in rows if "loss" in r]
+    assert losses[-1] < losses[0]  # it learned something
