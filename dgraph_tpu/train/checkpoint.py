@@ -129,11 +129,18 @@ def cached_edge_plan(
 ):
     """build_edge_plan with an on-disk cache (pickle of the numpy plan).
 
+    A falsy ``cache_dir`` ("" / None) builds without caching — the CLIs'
+    ``--plan_cache ""`` convention resolves here, not at every call site.
+
     Parity: `_save_comm_plans`/`_load_comm_plans`
     (``distributed_graph_dataset.py:399-422``).
     """
     from dgraph_tpu.plan import build_edge_plan
 
+    if not cache_dir:
+        return build_edge_plan(
+            edge_index, src_partition, dst_partition, **build_kwargs
+        )
     os.makedirs(cache_dir, exist_ok=True)
     # The RESOLVED Pallas tile sizes must be part of the key: they're
     # baked into the built plan, and build_edge_plan defaults them from

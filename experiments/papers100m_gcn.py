@@ -15,12 +15,14 @@ or a DIRECTORY of ``edge_index.npy`` / ``features.npy`` / ``labels.npy`` /
 ``train_mask.npy`` files — the directory form is opened with
 ``np.load(..., mmap_mode="r")``, so shard materialization streams rows from
 disk instead of first building a second in-RAM copy of the feature matrix.
-NOTE: this single-controller script still materializes ONE full padded
-[W, n_pad, F] copy host-side before device transfer (~57 GB for real
-papers100M); only the multi-controller path, which passes
-``process_local_shards`` to ``shard_rows``, keeps per-host residency at
-1/num_hosts of that. ``--synthetic_scale`` gives a shape-matched power-law
-synthetic at a chosen fraction of papers100M.
+Device placement streams per-device blocks (``shard_rows_to_device``), so
+host residency during sharding is ONE device's ``[n_pad, F]`` block — the
+stacked ``[W, n_pad, F]`` copy (~57 GB at real scale) never exists, and
+multi-controller hosts materialize only their own devices' rows.
+``--synthetic_scale`` gives a shape-matched power-law synthetic at a chosen
+fraction of papers100M (use ``data/memmap.synthetic_papers_like`` +
+``--data_npz <dir>`` to keep even the synthetic source on disk at large
+fractions).
 
 This script is single-controller; each run partitions and shards the full
 graph host-side. For multi-controller pods,
@@ -102,7 +104,7 @@ def _plan_only(cfg: Config, world: int) -> None:
 
     from dgraph_tpu import partition as pt
     from dgraph_tpu.data.synthetic import power_law_graph
-    from dgraph_tpu.plan import build_edge_plan, plan_memory_usage
+    from dgraph_tpu.plan import plan_memory_usage
     from dgraph_tpu.train.checkpoint import cached_edge_plan
 
     V = max(int(111_059_956 * cfg.synthetic_scale), 10_000)
@@ -124,15 +126,10 @@ def _plan_only(cfg: Config, world: int) -> None:
                "wall_s": round(t_part, 1), "peak_rss_gb": round(_peak_rss_gb(), 1)})
 
     t0 = time.perf_counter()
-    if cfg.plan_cache:
-        plan_np, layout = cached_edge_plan(
-            cfg.plan_cache, new_edges, ren.partition, world_size=world,
-            pad_multiple=cfg.pad_multiple,
-        )
-    else:
-        plan_np, layout = build_edge_plan(
-            new_edges, ren.partition, world_size=world, pad_multiple=cfg.pad_multiple
-        )
+    plan_np, layout = cached_edge_plan(
+        cfg.plan_cache, new_edges, ren.partition, world_size=world,
+        pad_multiple=cfg.pad_multiple,
+    )
     t_plan = time.perf_counter() - t0
     mem = plan_memory_usage(plan_np, feature_dim=128)
     log.write({
@@ -230,14 +227,20 @@ def main(cfg: Config):
     n_pad = plan_np.n_src_pad
 
     TimingReport.start("shard_data")
-    # shard_rows reads each shard's rows page-sequentially from the (possibly
-    # memmapped) source without ever materializing feats[ren.inv] whole
-    shards = range(world)
-    x = mm.shard_rows(feats, ren.inv, ren.offsets, n_pad, shards, np.float32)
-    y = mm.shard_rows(labels, ren.inv, ren.offsets, n_pad, shards, np.int32)
-    # dtype=np.float32 converts per shard — the bool memmap is never
-    # materialized as a full V-length float array host-side
-    m = mm.shard_rows(train_mask, ren.inv, ren.offsets, n_pad, shards, np.float32)
+    # blocks stream from the (possibly memmapped) source straight onto the
+    # mesh, one device's rows at a time — neither feats[ren.inv] nor the
+    # stacked [W, n_pad, F] copy ever exists host-side (~57 GB at real
+    # papers100M scale); multi-controller hosts materialize only their own
+    # devices' blocks
+    x = mm.shard_rows_to_device(
+        feats, ren.inv, ren.offsets, n_pad, mesh, dtype=np.float32
+    )
+    y = mm.shard_rows_to_device(
+        labels, ren.inv, ren.offsets, n_pad, mesh, dtype=np.int32
+    )
+    m = mm.shard_rows_to_device(
+        train_mask, ren.inv, ren.offsets, n_pad, mesh, dtype=np.float32
+    )
     TimingReport.stop("shard_data")
 
     dtype = jnp.bfloat16 if cfg.bfloat16 else None
@@ -250,7 +253,7 @@ def main(cfg: Config):
     model = cls(cfg.hidden, C, comm=comm, num_layers=cfg.num_layers, dtype=dtype)
 
     plan = jax.tree.map(jnp.asarray, plan_np)
-    batch = {"x": jnp.asarray(x), "y": jnp.asarray(y), "mask": jnp.asarray(m)}
+    batch = {"x": x, "y": y, "mask": m}
     params = init_params(model, mesh, plan, batch)
     optimizer = optax.adam(cfg.lr)
     opt_state = optimizer.init(params)
