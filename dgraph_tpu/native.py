@@ -52,7 +52,7 @@ def _load():
         lib = None
         try:
             lib = ctypes.CDLL(_SO)
-            lib.plan_core_begin  # newest entry point; missing = stale build
+            lib.cluster_coarsen_c  # newest entry point; missing = stale build
         except OSError:
             # a corrupt/truncated .so (interrupted link) fails CDLL outright
             # — no handle was cached, so ONE rebuild-and-retry is safe
@@ -64,7 +64,7 @@ def _load():
                     check=True, capture_output=True, timeout=120,
                 )
                 lib = ctypes.CDLL(_SO)
-                lib.plan_core_begin
+                lib.cluster_coarsen_c
             except Exception:
                 lib = None
         except AttributeError:
@@ -88,6 +88,21 @@ def _load():
             i64p, i64p, ctypes.c_int64, ctypes.c_int64, i64p,
         ]
         lib.unique_encoded_pairs.restype = ctypes.c_int64
+        lib.multilevel_partition_w_c.argtypes = [
+            i64p, i64p, i64p, ctypes.c_int64, i64p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_uint64, i32p,
+        ]
+        lib.multilevel_partition_w_c.restype = None
+        lib.cluster_coarsen_c.argtypes = [
+            i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_uint64, i64p,
+        ]
+        lib.cluster_coarsen_c.restype = ctypes.c_int64
+        lib.refine_unweighted_csr_c.argtypes = [
+            i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_double, i32p,
+        ]
+        lib.refine_unweighted_csr_c.restype = None
         lib.edge_cut_count.argtypes = [i64p, i64p, ctypes.c_int64, i32p]
         lib.edge_cut_count.restype = ctypes.c_int64
         f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
@@ -143,6 +158,68 @@ def multilevel_partition(
     out = np.empty(num_nodes, np.int32)
     lib.multilevel_partition_c(src, dst, len(src), num_nodes, world_size, seed, out)
     return out
+
+
+def cluster_coarsen(
+    edge_index: np.ndarray, num_nodes: int, max_cluster_weight: int,
+    seed: int = 0,
+) -> tuple[np.ndarray, int]:
+    """Capped greedy cluster coarsening (csrc ``cluster_coarsen_c``):
+    one int32 CSR + O(V) state instead of the WGraph stack. Returns
+    (cmap[V] int64 cluster ids, num_clusters)."""
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    src = np.ascontiguousarray(edge_index[0], np.int64)
+    dst = np.ascontiguousarray(edge_index[1], np.int64)
+    cmap = np.empty(num_nodes, np.int64)
+    nc = lib.cluster_coarsen_c(
+        src, dst, len(src), num_nodes, max_cluster_weight, seed, cmap
+    )
+    if nc < 0:
+        raise ValueError(
+            f"cluster_coarsen: {num_nodes} vertices exceed the int32 CSR "
+            "id bound (2^31-1)"
+        )
+    return cmap, int(nc)
+
+
+def multilevel_partition_weighted(
+    pair_src: np.ndarray, pair_dst: np.ndarray, pair_w: np.ndarray,
+    vertex_w: np.ndarray, num_vertices: int, world_size: int, seed: int = 0,
+) -> np.ndarray:
+    """Multilevel k-way partition of a weighted graph given as unique
+    undirected pairs (u < v) + weights; balance objective is summed vertex
+    weight (so cluster-coarsened supernodes stay fine-balanced)."""
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    out = np.empty(num_vertices, np.int32)
+    lib.multilevel_partition_w_c(
+        np.ascontiguousarray(pair_src, np.int64),
+        np.ascontiguousarray(pair_dst, np.int64),
+        np.ascontiguousarray(pair_w, np.int64),
+        len(pair_src),
+        np.ascontiguousarray(vertex_w, np.int64),
+        num_vertices, world_size, seed, out,
+    )
+    return out
+
+
+def refine_unweighted_csr(
+    edge_index: np.ndarray, num_nodes: int, world_size: int,
+    part: np.ndarray, passes: int = 3, imbalance: float = 1.03,
+) -> np.ndarray:
+    """In-place greedy boundary refinement on the fine graph (unit
+    weights, one int32 CSR). Returns ``part`` (modified in place when it
+    was already a contiguous int32 array)."""
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    src = np.ascontiguousarray(edge_index[0], np.int64)
+    dst = np.ascontiguousarray(edge_index[1], np.int64)
+    part = np.ascontiguousarray(part, np.int32)
+    lib.refine_unweighted_csr_c(
+        src, dst, len(src), num_nodes, world_size, passes, imbalance, part
+    )
+    return part
 
 
 def unique_encoded_pairs(keys: np.ndarray, vals: np.ndarray, stride: int) -> np.ndarray:

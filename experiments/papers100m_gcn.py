@@ -122,8 +122,17 @@ def _plan_only(cfg: Config, world: int) -> None:
     del edge_index
     gc.collect()
     t_part = time.perf_counter() - t0
+    # directed edge cut on the renumbered list (native O(E) streaming count
+    # when built; the VERDICT r4 #6 quality gate is cut <= 0.76)
+    from dgraph_tpu import native as _native
+
+    if _native.available():
+        cut = _native.edge_cut_count(new_edges, ren.partition) / max(E, 1)
+    else:
+        cut = pt.edge_cut(new_edges, ren.partition)
     log.write({"phase": "partition", "method": cfg.partition_method,
-               "wall_s": round(t_part, 1), "peak_rss_gb": round(_peak_rss_gb(), 1)})
+               "wall_s": round(t_part, 1), "cut": round(float(cut), 4),
+               "peak_rss_gb": round(_peak_rss_gb(), 1)})
 
     t0 = time.perf_counter()
     plan_np, layout = cached_edge_plan(

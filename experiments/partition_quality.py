@@ -110,6 +110,12 @@ def main(cfg: Config):
             elif method == "multilevel":
                 part = pt.multilevel_partition(
                     edges, cfg.num_nodes, cfg.world_size, cfg.seed)
+            elif method == "multilevel_big":
+                part = pt.multilevel_big_partition(
+                    edges, cfg.num_nodes, cfg.world_size, cfg.seed)
+            elif method == "multilevel_sampled":
+                part = pt.multilevel_sampled_partition(
+                    edges, cfg.num_nodes, cfg.world_size, cfg.seed)
             elif method == "rcm":
                 part = pt.rcm_partition(edges, cfg.num_nodes, cfg.world_size)
             else:
