@@ -155,15 +155,18 @@ def pallas_selfcheck() -> bool:
     return ok
 
 
-def pallas_fused_selfcheck() -> bool:
-    """Same chip gate for the FUSED bias+relu scatter kernel (its own kill
-    switch: a Mosaic regression here must not also disable the plain one)."""
+def pallas_fused_selfcheck() -> tuple[bool, bool]:
+    """Chip gate for the FUSED bias+relu scatter kernel, returning
+    (forward_ok, bwd_pair_ok). Graduated veto (ADVICE r4): a Mosaic
+    regression in only the backward KERNEL PAIR disables just
+    use_pallas_fused_bwd — the fused forward keeps running with the
+    composed backward — while a forward failure vetoes the whole op."""
     import numpy as np
     import jax
     import jax.numpy as jnp
 
     if jax.default_backend() != "tpu":
-        return False
+        return False, False
     from dgraph_tpu.ops.pallas_segment import (
         max_chunks_hint,
         sorted_segment_sum_bias_relu,
@@ -201,6 +204,8 @@ def pallas_fused_selfcheck() -> bool:
                 ).astype(jnp.float32),
                 ref, tol,
             )
+    if not ok:
+        return False, False  # forward broken: nothing downstream to save
     # gradient check: the unweighted VJP runs the fused-bwd KERNEL PAIR
     # (chunk-major gd kernel + epilogue="act" d_bias reduction) when
     # gather_mv > 0 — a Mosaic miscompile there would silently corrupt
@@ -236,7 +241,7 @@ def pallas_fused_selfcheck() -> bool:
             [gd.astype(jnp.float32).ravel(), db.astype(jnp.float32).ravel()]
         )
 
-    ok &= _check_one(
+    bwd_ok = _check_one(
         "fused-bwd-kernel-pair(grads,f32)", grads,
         np.concatenate([gd_want.ravel(), db_want.ravel()]), 2e-4,
     )
@@ -263,15 +268,16 @@ def pallas_fused_selfcheck() -> bool:
 
     try:
         ref_bf16 = np.asarray(grads_bf16(0))
-    except Exception as e:  # composed-reference failure must veto, not crash
+    except Exception as e:  # composed-reference failure: the fused op's
+        # own fallback bwd is broken — veto the whole op, not just the pair
         log(f"self-check fused-bwd-kernel-pair(grads,bf16) reference "
             f"raised {type(e).__name__}: {e}")
-        return False
-    ok &= _check_one(
+        return False, False
+    bwd_ok &= _check_one(
         "fused-bwd-kernel-pair(grads,bf16)", lambda: grads_bf16(mv),
         ref_bf16, 5e-2,
     )
-    return ok
+    return ok, bwd_ok
 
 
 def pallas_gather_selfcheck() -> bool:
@@ -699,7 +705,17 @@ def _child_main():
         fused_wanted = True
     else:  # auto: follow the plain-scatter decision
         fused_wanted = cfg.use_pallas_scatter
-    cfg.set_flags(use_pallas_fused=fused_wanted and pallas_fused_selfcheck())
+    fused_fwd_ok, fused_bwd_ok = (
+        pallas_fused_selfcheck() if fused_wanted else (False, False)
+    )
+    cfg.set_flags(use_pallas_fused=fused_wanted and fused_fwd_ok)
+    # graduated veto: a bwd-pair-only Mosaic failure keeps the fused
+    # forward (composed bwd) instead of losing the whole op; an env pin
+    # (use_pallas_fused_bwd is False) is already respected by the VJP
+    if fused_wanted and fused_fwd_ok and not fused_bwd_ok:
+        log("fused-bwd kernel pair vetoed by self-check; "
+            "keeping fused fwd with the composed backward")
+        cfg.set_flags(use_pallas_fused_bwd=False)
     # sorted row-gather kernel: explicit opt-in only (no auto state yet —
     # see config.use_pallas_gather); the chip self-check has the veto
     if cfg.use_pallas_gather is True:

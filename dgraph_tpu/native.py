@@ -213,6 +213,14 @@ def refine_unweighted_csr(
     was already a contiguous int32 array)."""
     lib = _load()
     assert lib is not None, "native library unavailable"
+    if num_nodes >= 2**31 - 1:
+        # the C side would silently no-op (build_csr32 refuses); the
+        # refine stage is load-bearing for multilevel_sampled, so fail
+        # loudly like cluster_coarsen does
+        raise ValueError(
+            f"refine_unweighted_csr: {num_nodes} vertices exceed the "
+            "int32 CSR id bound (2^31-1)"
+        )
     src = np.ascontiguousarray(edge_index[0], np.int64)
     dst = np.ascontiguousarray(edge_index[1], np.int64)
     part = np.ascontiguousarray(part, np.int32)

@@ -169,8 +169,12 @@ def multilevel_big_partition(
        ~4 bytes x 2E CSR) — one aggressive level instead of ~log V
        matching levels;
     2. chunked numpy contraction to unique weighted coarse pairs (the
-       edge list may be a disk memmap; each chunk is deduped before the
-       merged dedup, so transients stay bounded);
+       edge list may be a disk memmap; per-chunk dedup happens before
+       the merged dedup, but the merge itself still sorts ALL surviving
+       pairs — on hub-heavy graphs coarse pairs stay near E (measured
+       ~0.93E even at 16x vertex reduction), so the merge transient is
+       O(E) ints, not bounded; :func:`multilevel_sampled_partition` is
+       the default full-papers100M path for exactly this reason);
     3. the full in-RAM multilevel+FM+volume-polish stack on the coarse
        graph (native ``multilevel_partition_w_c`` — balance objective is
        summed fine-vertex weight);
@@ -214,7 +218,9 @@ def multilevel_big_partition(
     enc = np.concatenate(enc_parts) if enc_parts else np.zeros(0, np.int64)
     cnt = np.concatenate(cnt_parts) if cnt_parts else np.zeros(0, np.int64)
     del enc_parts, cnt_parts
-    order = np.argsort(enc, kind="stable")
+    # no kind="stable": reduceat sums equal keys regardless of their
+    # relative order, and introsort skips mergesort's working buffer
+    order = np.argsort(enc)
     enc, cnt = enc[order], cnt[order]
     del order
     starts = np.flatnonzero(

@@ -60,6 +60,10 @@ bench_ab() {  # bench_ab NAME "ENV=VAL ..."
 # 1. default with the Mosaic bf16 [:,None] fix (fused kernel should now
 #    pass its self-check)
 bench_ab fusedfix ""
+# 1b. fused-backward kernel pair OFF (the r5 kill switch,
+#     DGRAPH_TPU_PALLAS_FUSED_BWD): isolates the pair's contribution to
+#     the headline; the fused fwd stays on with the composed backward
+bench_ab fusedbwd0 "DGRAPH_TPU_PALLAS_FUSED_BWD=0"
 # 2. column chunking OFF — the invalidated-default suspect; the surviving
 #    sweep rows already show plain beating col_split at F=128
 bench_ab nocolblk "DGRAPH_TPU_GATHER_COL_BLOCK=0"
