@@ -139,8 +139,10 @@ run_stage bench_graphcast bash -c 'DGRAPH_BENCH_TIMEOUT=3000 python bench.py > l
 date -u +"%Y-%m-%dT%H:%M:%SZ full json: $(tail -1 logs/bench_r4_full.json 2>/dev/null)"
 commit_stage bench_graphcast logs/bench_r4_full.json logs/bench_r4_full.err
 
-# 10. papers100M ladder (original stage 7)
-for s in 0.002 0.005 0.01 0.02; do
+# 10. papers100M ladder (original stage 7; 0.05 rung added in r5 — the
+#     streamed per-device sharding removed the host-side [W,n_pad,F]
+#     stack, so the data path no longer caps the rung before HBM does)
+for s in 0.002 0.005 0.01 0.02 0.05; do
   run_stage "p100m scale=$s" bash -c "set -o pipefail; timeout 2400 python experiments/papers100m_gcn.py --synthetic_scale $s --epochs 3 --world_size 1 --log_path logs/p100m_step.jsonl 2>&1 | tail -5" || break
 done
 commit_stage p100m logs/p100m_step.jsonl
