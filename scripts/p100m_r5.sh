@@ -1,0 +1,20 @@
+#!/bin/bash
+# Staged full-papers100M partition+plan (restartable; each stage skips if
+# its artifact exists). Commits the log when all stages land.
+cd /root/repo
+set -o pipefail
+exec >> logs/p100m_r5_stages.log 2>&1
+export DGRAPH_HOST_FM_TABLE_GB=12
+date -u +"%Y-%m-%dT%H:%M:%SZ p100m r5 staged run start"
+for stage in generate partition plan; do
+  date -u +"%Y-%m-%dT%H:%M:%SZ stage $stage start"
+  if ! python scripts/p100m_r5_stages.py "$stage"; then
+    date -u +"%Y-%m-%dT%H:%M:%SZ stage $stage FAILED rc=$?"
+    exit 1
+  fi
+done
+date -u +"%Y-%m-%dT%H:%M:%SZ all stages done"
+git add -f logs/p100m_fullscale_r5.jsonl logs/p100m_r5_stages.log
+git commit -q -m "Full-scale papers100M multilevel_sampled partition + plan artifacts
+
+No-Verification-Needed: measurement logs only" || true

@@ -1,0 +1,136 @@
+"""Staged full-papers100M partition + plan build (VERDICT r4 #6).
+
+The one-process plan_only flow OOM-killed at 130.7 GB with
+multilevel_sampled p=0.5: the in-RAM edge list (25.8 GB) + the sample +
+the WGraph build transients stacked. This splits the flow into three
+PROCESSES so each phase's peak stands alone and a failure never re-pays
+an earlier phase:
+
+  generate   power_law(111M, 14.5) -> cache/p100m/edges.npy (disk, 26 GB)
+  partition  memmap edges -> multilevel_sampled(p=0.35) -> part.npy + cut
+  plan       memmap edges + part -> renumber -> cached plan build
+
+Usage: python scripts/p100m_r5_stages.py {generate|partition|plan}
+(scripts/p100m_r5.sh runs all three and commits the log.)
+
+Same generator/seed as experiments/papers100m_gcn.py --plan_only, so the
+phase rows in logs/p100m_fullscale_r5.jsonl are comparable with r4's
+greedy_bfs full-scale record (logs/p100m_fullscale.jsonl).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V = 111_059_956
+AVG_DEGREE = 14.5
+WORLD = 8
+SAMPLE_FRAC = 0.35
+SEED = 0
+CACHE = "cache/p100m"
+LOG = "logs/p100m_fullscale_r5.jsonl"
+EDGES = os.path.join(CACHE, "edges.npy")
+PART = os.path.join(CACHE, "part.npy")
+
+
+def _rss_gb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def _log(rec: dict) -> None:
+    os.makedirs(os.path.dirname(LOG), exist_ok=True)
+    rec["peak_rss_gb"] = round(_rss_gb(), 1)
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def generate() -> None:
+    if os.path.exists(EDGES):
+        print(f"{EDGES} exists; skipping generate", flush=True)
+        return
+    from dgraph_tpu.data.synthetic import power_law_graph
+
+    os.makedirs(CACHE, exist_ok=True)
+    t0 = time.perf_counter()
+    edges = power_law_graph(V, AVG_DEGREE, seed=SEED)
+    np.save(EDGES + ".tmp.npy", edges)
+    os.replace(EDGES + ".tmp.npy", EDGES)
+    _log({"phase": "generate", "nodes": V, "edges": int(edges.shape[1]),
+          "wall_s": round(time.perf_counter() - t0, 1), "on_disk": EDGES})
+
+
+def _chunked_cut(edges: np.ndarray, part: np.ndarray,
+                 chunk: int = 1 << 26) -> float:
+    E = edges.shape[1]
+    cross = 0
+    for lo in range(0, E, chunk):
+        blk = np.asarray(edges[:, lo:lo + chunk])
+        cross += int((part[blk[0]] != part[blk[1]]).sum())
+    return cross / max(E, 1)
+
+
+def partition() -> None:
+    if os.path.exists(PART):
+        print(f"{PART} exists; skipping partition", flush=True)
+        return
+    from dgraph_tpu import partition as pt
+
+    edges = np.load(EDGES, mmap_mode="r")
+    t0 = time.perf_counter()
+    part = pt.multilevel_sampled_partition(
+        edges, V, WORLD, seed=SEED, sample_frac=SAMPLE_FRAC,
+    )
+    wall = time.perf_counter() - t0
+    np.save(PART + ".tmp.npy", part)
+    os.replace(PART + ".tmp.npy", PART)
+    cut = _chunked_cut(edges, part)
+    counts = np.bincount(part, minlength=WORLD)
+    _log({"phase": "partition", "method": "multilevel_sampled",
+          "sample_frac": SAMPLE_FRAC, "wall_s": round(wall, 1),
+          "cut": round(float(cut), 4),
+          "balance": round(float(counts.max() / (V / WORLD)), 4)})
+
+
+def plan() -> None:
+    from dgraph_tpu import partition as pt
+    from dgraph_tpu.plan import plan_memory_usage
+    from dgraph_tpu.train.checkpoint import cached_edge_plan
+
+    edges = np.load(EDGES, mmap_mode="r")
+    part = np.load(PART)
+    t0 = time.perf_counter()
+    ren = pt.renumber_contiguous(part, WORLD)
+    del part
+    # renumber the memmapped edge list chunk-wise into one in-RAM array
+    # (the plan core wants contiguous int64 [2, E])
+    E = edges.shape[1]
+    new_edges = np.empty((2, E), np.int64)
+    chunk = 1 << 26
+    for lo in range(0, E, chunk):
+        blk = np.asarray(edges[:, lo:lo + chunk])
+        new_edges[:, lo:lo + blk.shape[1]] = ren.perm[blk]
+    plan_np, layout = cached_edge_plan(
+        "cache/plans", new_edges, ren.partition, world_size=WORLD,
+        pad_multiple=128,
+    )
+    mem = plan_memory_usage(plan_np, feature_dim=128)
+    _log({
+        "phase": "plan_build", "wall_s": round(time.perf_counter() - t0, 1),
+        "e_pad": int(plan_np.e_pad), "s_pad": int(plan_np.halo.s_pad),
+        "halo_pairs": int(layout.halo_counts.sum()),
+        "halo_pair_fraction": round(float(layout.halo_counts.sum()) / max(E, 1), 4),
+        "plan_bytes": {k: int(v) for k, v in mem.items()},
+    })
+
+
+if __name__ == "__main__":
+    {"generate": generate, "partition": partition, "plan": plan}[sys.argv[1]]()
