@@ -9,7 +9,8 @@ date -u +"%Y-%m-%dT%H:%M:%SZ p100m r5 staged run start"
 for stage in generate partition plan; do
   date -u +"%Y-%m-%dT%H:%M:%SZ stage $stage start"
   if ! python scripts/p100m_r5_stages.py "$stage"; then
-    date -u +"%Y-%m-%dT%H:%M:%SZ stage $stage FAILED rc=$?"
+    rc=$?
+    date -u +"%Y-%m-%dT%H:%M:%SZ stage $stage FAILED rc=$rc"
     exit 1
   fi
 done

@@ -101,6 +101,8 @@ def partition() -> None:
 
 
 def plan() -> None:
+    import gc
+
     from dgraph_tpu import partition as pt
     from dgraph_tpu.plan import plan_memory_usage
     from dgraph_tpu.train.checkpoint import cached_edge_plan
@@ -110,18 +112,30 @@ def plan() -> None:
     t0 = time.perf_counter()
     ren = pt.renumber_contiguous(part, WORLD)
     del part
-    # renumber the memmapped edge list chunk-wise into one in-RAM array
-    # (the plan core wants contiguous int64 [2, E])
+    # renumber the memmapped edge list chunk-wise TO DISK: an in-RAM
+    # [2, E] int64 copy (25.8 GB anon) on top of the plan core's own
+    # transients OOM-killed the first attempt at ~130 GB; the core reads
+    # src/dst in sequential passes, so file-backed pages reclaim under
+    # pressure instead of counting against the OOM killer
     E = edges.shape[1]
-    new_edges = np.empty((2, E), np.int64)
+    ne_path = os.path.join(CACHE, "new_edges.npy")
+    new_edges = np.lib.format.open_memmap(
+        ne_path, mode="w+", dtype=np.int64, shape=(2, E)
+    )
     chunk = 1 << 26
     for lo in range(0, E, chunk):
         blk = np.asarray(edges[:, lo:lo + chunk])
         new_edges[:, lo:lo + blk.shape[1]] = ren.perm[blk]
+    new_edges.flush()
+    partition_arr = ren.partition
+    del ren, new_edges
+    gc.collect()
+    new_edges = np.load(ne_path, mmap_mode="r")
     plan_np, layout = cached_edge_plan(
-        "cache/plans", new_edges, ren.partition, world_size=WORLD,
+        "cache/plans", new_edges, partition_arr, world_size=WORLD,
         pad_multiple=128,
     )
+    os.remove(ne_path)
     mem = plan_memory_usage(plan_np, feature_dim=128)
     _log({
         "phase": "plan_build", "wall_s": round(time.perf_counter() - t0, 1),
