@@ -8,8 +8,9 @@ export DGRAPH_HOST_FM_TABLE_GB=12
 date -u +"%Y-%m-%dT%H:%M:%SZ p100m r5 staged run start"
 for stage in generate partition plan; do
   date -u +"%Y-%m-%dT%H:%M:%SZ stage $stage start"
-  if ! python scripts/p100m_r5_stages.py "$stage"; then
-    rc=$?
+  python scripts/p100m_r5_stages.py "$stage"
+  rc=$?
+  if [ $rc -ne 0 ]; then
     date -u +"%Y-%m-%dT%H:%M:%SZ stage $stage FAILED rc=$rc"
     exit 1
   fi

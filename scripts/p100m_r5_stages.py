@@ -105,7 +105,6 @@ def plan() -> None:
 
     from dgraph_tpu import partition as pt
     from dgraph_tpu.plan import plan_memory_usage
-    from dgraph_tpu.train.checkpoint import cached_edge_plan
 
     edges = np.load(EDGES, mmap_mode="r")
     part = np.load(PART)
@@ -131,9 +130,14 @@ def plan() -> None:
     del ren, new_edges
     gc.collect()
     new_edges = np.load(ne_path, mmap_mode="r")
-    plan_np, layout = cached_edge_plan(
-        "cache/plans", new_edges, partition_arr, world_size=WORLD,
-        pad_multiple=128,
+    # no on-disk plan cache: the full-scale EdgePlan pickle is ~40+ GB
+    # (attempt 1's orphaned tmp pickle filled the disk and SIGBUS'd
+    # attempt 2's memmap writes); the logged build stats are the
+    # artifact, and part.npy lets any later run rebuild in ~1 h
+    from dgraph_tpu.plan import build_edge_plan
+
+    plan_np, layout = build_edge_plan(
+        new_edges, partition_arr, world_size=WORLD, pad_multiple=128,
     )
     os.remove(ne_path)
     mem = plan_memory_usage(plan_np, feature_dim=128)
