@@ -738,6 +738,22 @@ extern "C" void multilevel_partition_c(const int64_t* src, const int64_t* dst,
                        out_part);
 }
 
+// Raw-edge-list entry with CALLER vertex weights: same multilevel body,
+// balance objective Σ vw per rank. The full-scale papers100M record
+// showed why this exists: vertex-balanced partitions leave the EDGE
+// distribution 1.28x imbalanced (e_pad 257.6M vs the 201M/rank mean,
+// logs/p100m_fullscale_r5.jsonl), and e_pad sizes the dominant runtime
+// edge buffers; vw = 1 + alpha*degree trades a little vertex padding for
+// edge balance.
+extern "C" void multilevel_partition_vw_c(
+    const int64_t* src, const int64_t* dst, int64_t num_edges,
+    const int64_t* vw, int64_t num_vertices, int32_t world_size,
+    uint64_t seed, int32_t* out_part) {
+  WGraph g = build_wgraph(src, dst, num_edges, num_vertices);
+  g.vw.assign(vw, vw + num_vertices);
+  multilevel_core(std::move(g), world_size, seed, out_part);
+}
+
 // Weighted entry: unique undirected pairs + weights + vertex weights (the
 // chunked contraction's output). The balance objective is Σ vw per rank,
 // so a partition of cluster-coarsened supernodes stays balanced in FINE
@@ -785,14 +801,15 @@ bool build_csr32(const int64_t* src, const int64_t* dst, int64_t num_edges,
   return true;
 }
 
-// Force every rank under cap on an int32 CSR with unit weights — the
-// CSR-form sibling of rebalance_to_cap (same policy: shed over-cap ranks
-// to the best-connected under-cap rank, tie-break most underfull; keep
-// the two in lock-step when changing the heuristic).
+// Force every rank under cap on an int32 CSR — the CSR-form sibling of
+// rebalance_to_cap (same policy: shed over-cap ranks to the
+// best-connected under-cap rank, tie-break most underfull; keep the two
+// in lock-step when changing the heuristic). vw == nullptr means unit
+// vertex weights; otherwise the cap is on Σ vw (edge-balance blends).
 void rebalance_csr32(const std::vector<int64_t>& indptr,
                      const std::vector<int32_t>& adj, int64_t num_vertices,
-                     int32_t W, int64_t cap, int32_t* part,
-                     std::vector<int64_t>& pw) {
+                     int32_t W, int64_t cap, const int64_t* vw,
+                     int32_t* part, std::vector<int64_t>& pw) {
   std::vector<int64_t> conn(W, 0);
   for (int sweep = 0; sweep < 8; ++sweep) {
     bool over = false;
@@ -802,13 +819,14 @@ void rebalance_csr32(const std::vector<int64_t>& indptr,
     for (int64_t v = 0; v < num_vertices; ++v) {
       const int32_t pv = part[v];
       if (pw[pv] <= cap) continue;
+      const int64_t w = vw ? vw[v] : 1;
       std::fill(conn.begin(), conn.end(), 0);
       for (int64_t k = indptr[v]; k < indptr[v + 1]; ++k)
         ++conn[part[adj[k]]];
       int32_t best = -1;
       int64_t best_conn = -1, best_pw = INT64_MAX;
       for (int32_t r = 0; r < W; ++r) {
-        if (r == pv || pw[r] + 1 > cap) continue;
+        if (r == pv || pw[r] + w > cap) continue;
         if (conn[r] > best_conn ||
             (conn[r] == best_conn && pw[r] < best_pw)) {
           best = r;
@@ -817,8 +835,8 @@ void rebalance_csr32(const std::vector<int64_t>& indptr,
         }
       }
       if (best >= 0) {
-        --pw[pv];
-        ++pw[best];
+        pw[pv] -= w;
+        pw[best] += w;
         part[v] = best;
         moved = true;
       }
@@ -909,25 +927,35 @@ extern "C" int64_t cluster_coarsen_c(const int64_t* src, const int64_t* dst,
 }
 
 // Greedy positive-gain boundary refinement on the FINE graph after
-// projection, unit vertex weights, one int32 CSR — the memory-bounded
-// counterpart of refine() for graphs whose WGraph doesn't fit. O(E) per
-// pass (boundary check + conn scan are both neighbor scans).
-extern "C" void refine_unweighted_csr_c(const int64_t* src, const int64_t* dst,
-                                        int64_t num_edges,
-                                        int64_t num_vertices, int32_t W,
-                                        int32_t passes, double imbalance,
-                                        int32_t* part) {
+// projection, one int32 CSR — the memory-bounded counterpart of refine()
+// for graphs whose WGraph doesn't fit. O(E) per pass (boundary check +
+// conn scan are both neighbor scans). The cut GAIN is always unit edge
+// counts; vw (nullable) only changes what the balance cap sums — the
+// edge-balance blend must use the same vw here as in the coarse stage,
+// or this refine's rebalance undoes the blend (measured: e_imb 1.14
+// pre-refine -> 1.25 after a unit-count refine at 2M power-law).
+namespace {
+void refine_csr_impl(const int64_t* src, const int64_t* dst,
+                     int64_t num_edges, int64_t num_vertices, int32_t W,
+                     int32_t passes, double imbalance, const int64_t* vw,
+                     int32_t* part) {
   std::vector<int64_t> indptr;
   std::vector<int32_t> adj;
   if (!build_csr32(src, dst, num_edges, num_vertices, indptr, adj)) return;
+  int64_t total_w = 0;
+  if (vw) {
+    for (int64_t v = 0; v < num_vertices; ++v) total_w += vw[v];
+  } else {
+    total_w = num_vertices;
+  }
   const int64_t cap =
-      static_cast<int64_t>((double(num_vertices) / W) * imbalance) + 1;
+      static_cast<int64_t>((double(total_w) / W) * imbalance) + 1;
   std::vector<int64_t> pw(W, 0);
-  for (int64_t v = 0; v < num_vertices; ++v) ++pw[part[v]];
+  for (int64_t v = 0; v < num_vertices; ++v) pw[part[v]] += vw ? vw[v] : 1;
   // rebalance first: an over-cap input (e.g. a projected partition built
   // under different weights) can never be fixed by gain-driven passes —
   // they only refuse to create new violations
-  rebalance_csr32(indptr, adj, num_vertices, W, cap, part, pw);
+  rebalance_csr32(indptr, adj, num_vertices, W, cap, vw, part, pw);
   std::vector<int64_t> conn(W, 0);
   for (int32_t p = 0; p < passes; ++p) {
     int64_t moves = 0;
@@ -937,25 +965,45 @@ extern "C" void refine_unweighted_csr_c(const int64_t* src, const int64_t* dst,
       for (int64_t k = indptr[v]; k < indptr[v + 1]; ++k)
         if (part[adj[k]] != pv) { boundary = true; break; }
       if (!boundary) continue;
+      const int64_t w = vw ? vw[v] : 1;
       std::fill(conn.begin(), conn.end(), 0);
       for (int64_t k = indptr[v]; k < indptr[v + 1]; ++k)
         ++conn[part[adj[k]]];
       int32_t best = pv;
       int64_t best_gain = 0;
       for (int32_t r = 0; r < W; ++r) {
-        if (r == pv || pw[r] + 1 > cap) continue;
+        if (r == pv || pw[r] + w > cap) continue;
         const int64_t gain = conn[r] - conn[pv];
         if (gain > best_gain) { best = r; best_gain = gain; }
       }
       if (best != pv) {
-        --pw[pv];
-        ++pw[best];
+        pw[pv] -= w;
+        pw[best] += w;
         part[v] = best;
         ++moves;
       }
     }
     if (!moves) break;
   }
+}
+}  // namespace
+
+extern "C" void refine_unweighted_csr_c(const int64_t* src, const int64_t* dst,
+                                        int64_t num_edges,
+                                        int64_t num_vertices, int32_t W,
+                                        int32_t passes, double imbalance,
+                                        int32_t* part) {
+  refine_csr_impl(src, dst, num_edges, num_vertices, W, passes, imbalance,
+                  nullptr, part);
+}
+
+extern "C" void refine_weighted_csr_c(const int64_t* src, const int64_t* dst,
+                                      int64_t num_edges,
+                                      int64_t num_vertices, int32_t W,
+                                      int32_t passes, double imbalance,
+                                      const int64_t* vw, int32_t* part) {
+  refine_csr_impl(src, dst, num_edges, num_vertices, W, passes, imbalance,
+                  vw, part);
 }
 
 // Deduplicate (key, value) pairs encoded as key*stride+value, sorted.

@@ -52,7 +52,7 @@ def _load():
         lib = None
         try:
             lib = ctypes.CDLL(_SO)
-            lib.cluster_coarsen_c  # newest entry point; missing = stale build
+            lib.refine_weighted_csr_c  # newest entry point; missing = stale build
         except OSError:
             # a corrupt/truncated .so (interrupted link) fails CDLL outright
             # — no handle was cached, so ONE rebuild-and-retry is safe
@@ -64,7 +64,7 @@ def _load():
                     check=True, capture_output=True, timeout=120,
                 )
                 lib = ctypes.CDLL(_SO)
-                lib.cluster_coarsen_c
+                lib.refine_weighted_csr_c
             except Exception:
                 lib = None
         except AttributeError:
@@ -93,6 +93,11 @@ def _load():
             ctypes.c_int32, ctypes.c_uint64, i32p,
         ]
         lib.multilevel_partition_w_c.restype = None
+        lib.multilevel_partition_vw_c.argtypes = [
+            i64p, i64p, ctypes.c_int64, i64p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_uint64, i32p,
+        ]
+        lib.multilevel_partition_vw_c.restype = None
         lib.cluster_coarsen_c.argtypes = [
             i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_uint64, i64p,
@@ -103,6 +108,11 @@ def _load():
             ctypes.c_int32, ctypes.c_double, i32p,
         ]
         lib.refine_unweighted_csr_c.restype = None
+        lib.refine_weighted_csr_c.argtypes = [
+            i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_double, i64p, i32p,
+        ]
+        lib.refine_weighted_csr_c.restype = None
         lib.edge_cut_count.argtypes = [i64p, i64p, ctypes.c_int64, i32p]
         lib.edge_cut_count.restype = ctypes.c_int64
         f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
@@ -204,6 +214,26 @@ def multilevel_partition_weighted(
     return out
 
 
+def multilevel_partition_vertex_weighted(
+    edge_index: np.ndarray, vertex_w: np.ndarray, num_nodes: int,
+    world_size: int, seed: int = 0,
+) -> np.ndarray:
+    """Multilevel k-way partition of a raw edge list balancing summed
+    CALLER vertex weights (e.g. 1 + alpha*degree to co-balance edges —
+    see multilevel_partition_vw_c)."""
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    out = np.empty(num_nodes, np.int32)
+    lib.multilevel_partition_vw_c(
+        np.ascontiguousarray(edge_index[0], np.int64),
+        np.ascontiguousarray(edge_index[1], np.int64),
+        edge_index.shape[1],
+        np.ascontiguousarray(vertex_w, np.int64),
+        num_nodes, world_size, seed, out,
+    )
+    return out
+
+
 def refine_unweighted_csr(
     edge_index: np.ndarray, num_nodes: int, world_size: int,
     part: np.ndarray, passes: int = 3, imbalance: float = 1.03,
@@ -226,6 +256,32 @@ def refine_unweighted_csr(
     part = np.ascontiguousarray(part, np.int32)
     lib.refine_unweighted_csr_c(
         src, dst, len(src), num_nodes, world_size, passes, imbalance, part
+    )
+    return part
+
+
+def refine_weighted_csr(
+    edge_index: np.ndarray, vertex_w: np.ndarray, num_nodes: int,
+    world_size: int, part: np.ndarray, passes: int = 3,
+    imbalance: float = 1.03,
+) -> np.ndarray:
+    """Greedy boundary refinement with a Σ vertex-weight balance cap (cut
+    gain stays unit edge counts). The edge-balance blend must refine
+    under the SAME weights it partitioned with — a unit-count refine
+    undoes the blend (see refine_weighted_csr_c)."""
+    lib = _load()
+    assert lib is not None, "native library unavailable"
+    if num_nodes >= 2**31 - 1:
+        raise ValueError(
+            f"refine_weighted_csr: {num_nodes} vertices exceed the "
+            "int32 CSR id bound (2^31-1)"
+        )
+    part = np.ascontiguousarray(part, np.int32)
+    lib.refine_weighted_csr_c(
+        np.ascontiguousarray(edge_index[0], np.int64),
+        np.ascontiguousarray(edge_index[1], np.int64),
+        edge_index.shape[1], num_nodes, world_size, passes, imbalance,
+        np.ascontiguousarray(vertex_w, np.int64), part,
     )
     return part
 

@@ -248,6 +248,7 @@ def multilevel_sampled_partition(
     sample_frac: float = 0.5,
     refine_passes: int = 3,
     chunk: int = 1 << 26,
+    edge_balance: float = 0.0,
 ) -> np.ndarray:
     """Full multilevel+FM stack on a uniform edge sample, then greedy
     boundary refinement on the full graph (native
@@ -280,12 +281,44 @@ def multilevel_sampled_partition(
     rng = np.random.default_rng(seed)
     E = edge_index.shape[1]
     parts = []
+    deg_in = (
+        np.zeros(num_nodes, np.int64) if edge_balance > 0 else None
+    )
     for lo in range(0, E, chunk):
         hi = min(lo + chunk, E)
+        blk = np.asarray(edge_index[:, lo:hi])
+        if deg_in is not None:
+            # plans own edges at the dst vertex, so per-rank edge volume
+            # is summed IN-degree of owned vertices — that's the weight
+            # that co-balances e_pad
+            deg_in += np.bincount(blk[1], minlength=num_nodes)
         keep = rng.random(hi - lo) < sample_frac
-        parts.append(np.asarray(edge_index[:, lo:hi])[:, keep])
+        parts.append(blk[:, keep])
     sub = np.ascontiguousarray(np.concatenate(parts, axis=1))
     del parts
+    if deg_in is not None:
+        # vw = 16 + round(16*alpha*deg/mean_deg): Σvw ≈ 16V(1+alpha); the
+        # x16 scale keeps integer rounding from quantizing small alphas.
+        # A vertex-balanced partition leaves owner-edge volume ~1.28x
+        # imbalanced at papers100M scale (logs/p100m_fullscale_r5.jsonl
+        # e_pad) because hub in-degrees concentrate; the blend trades a
+        # little vertex padding (n_pad) for edge balance (e_pad).
+        mean_deg = max(E / num_nodes, 1e-9)
+        vw = 16 + np.rint(16.0 * edge_balance * deg_in / mean_deg).astype(
+            np.int64
+        )
+        del deg_in
+        part = native.multilevel_partition_vertex_weighted(
+            sub, vw, num_nodes, world_size, seed
+        )
+        del sub
+        # refine under the SAME weights: a unit-count refine rebalances
+        # vertex counts to 1.03 and undoes the edge balance (measured at
+        # 2M: e_imb 1.14 pre-refine -> 1.25 post-unit-refine)
+        return native.refine_weighted_csr(
+            edge_index, vw, num_nodes, world_size, part,
+            passes=refine_passes,
+        )
     part = multilevel_partition(sub, num_nodes, world_size, seed)
     del sub
     return native.refine_unweighted_csr(
