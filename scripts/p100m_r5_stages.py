@@ -72,14 +72,20 @@ def generate() -> None:
           "wall_s": round(time.perf_counter() - t0, 1), "on_disk": EDGES})
 
 
-def _chunked_cut(edges: np.ndarray, part: np.ndarray,
-                 chunk: int = 1 << 26) -> float:
+def _chunked_cut_and_edge_counts(
+    edges: np.ndarray, part: np.ndarray, chunk: int = 1 << 26
+) -> tuple[float, np.ndarray]:
+    """One streaming pass over the (memmapped) edge list: directed cut
+    fraction + owner-side (dst) edge count per rank."""
     E = edges.shape[1]
     cross = 0
+    ec = np.zeros(WORLD, np.int64)
     for lo in range(0, E, chunk):
         blk = np.asarray(edges[:, lo:lo + chunk])
-        cross += int((part[blk[0]] != part[blk[1]]).sum())
-    return cross / max(E, 1)
+        pd = part[blk[1]]
+        cross += int((part[blk[0]] != pd).sum())
+        ec += np.bincount(pd, minlength=WORLD)
+    return cross / max(E, 1), ec
 
 
 def partition() -> None:
@@ -97,13 +103,8 @@ def partition() -> None:
     wall = time.perf_counter() - t0
     np.save(PART + ".tmp.npy", part)
     os.replace(PART + ".tmp.npy", PART)
-    cut = _chunked_cut(edges, part)
+    cut, ec = _chunked_cut_and_edge_counts(edges, part)
     counts = np.bincount(part, minlength=WORLD)
-    ec = np.zeros(WORLD, np.int64)
-    E = edges.shape[1]
-    for lo in range(0, E, 1 << 26):
-        blk = np.asarray(edges[1, lo:lo + (1 << 26)])
-        ec += np.bincount(part[blk], minlength=WORLD)
     _log({"phase": "partition", "method": "multilevel_sampled",
           "sample_frac": SAMPLE_FRAC, "edge_balance": EDGE_BALANCE,
           "wall_s": round(wall, 1), "cut": round(float(cut), 4),
@@ -153,7 +154,8 @@ def plan() -> None:
     os.remove(ne_path)
     mem = plan_memory_usage(plan_np, feature_dim=128)
     _log({
-        "phase": "plan_build", "wall_s": round(time.perf_counter() - t0, 1),
+        "phase": "plan_build", "edge_balance": EDGE_BALANCE, "part": PART,
+        "wall_s": round(time.perf_counter() - t0, 1),
         "e_pad": int(plan_np.e_pad), "s_pad": int(plan_np.halo.s_pad),
         "halo_pairs": int(layout.halo_counts.sum()),
         "halo_pair_fraction": round(float(layout.halo_counts.sum()) / max(E, 1), 4),
