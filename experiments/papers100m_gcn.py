@@ -63,6 +63,10 @@ class Config:
     # build is the scaling-critical artifact — this measures its wall time
     # and peak RSS the way the reference's offline per-rank plan precompute
     # would be measured (MAG240M_dataset.py:237-260).
+    # NOTE: at synthetic_scale=1.0 prefer scripts/p100m_r5.sh — the
+    # single-process flow stacks the edge list, sample, and plan
+    # transients in one address space (OOM-killed at 130.7 GB on a 125 GB
+    # host); the staged pipeline keeps each phase's peak standalone.
     plan_only: bool = False
 
 
