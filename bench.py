@@ -52,6 +52,34 @@ def log(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
+_HEALTH_MOD = None
+_HEALTH = None  # this process's RunHealth (child or supervisor)
+
+
+def _health_mod():
+    """Load obs/health.py WITHOUT importing the dgraph_tpu package: the
+    package __init__ imports jax, and the supervisor must never do that
+    (a wedged lease hangs backend init inside a GIL-holding C call — the
+    exact failure this harness exists to survive). health.py itself is
+    dependency-free."""
+    global _HEALTH_MOD
+    if _HEALTH_MOD is None:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "dgraph_tpu", "obs", "health.py",
+        )
+        spec = importlib.util.spec_from_file_location("_dgraph_obs_health", path)
+        mod = importlib.util.module_from_spec(spec)
+        # register BEFORE exec: dataclass field-type resolution looks the
+        # module up in sys.modules while the class is being built
+        sys.modules["_dgraph_obs_health"] = mod
+        spec.loader.exec_module(mod)
+        _HEALTH_MOD = mod
+    return _HEALTH_MOD
+
+
 def _make_runner(scan_fn):
     """(params, opt_state, salt), n -> new state; the trailing float(s)
     scalar fetch is the only trustworthy completion barrier on the tunnel."""
@@ -565,23 +593,31 @@ def _note_partial(**kw):
 EXIT_PARTIAL, EXIT_EMPTY, EXIT_BACKEND = 4, 3, 5
 
 
-def _failure_json(error: str, state: dict, empty_rc: int):
+def _failure_json(error: str, state: dict, empty_rc: int, wedge=None):
     """The ONE place the failure-path output schema + partial/empty rc rule
     live (child watchdog, child exception paths, and the supervisor all
-    funnel here — forking the schema between them would be silent)."""
+    funnel here — forking the schema between them would be silent). When
+    this process carries a RunHealth record (child or supervisor), it is
+    embedded so the artifact alone explains the null (obs.health)."""
     out = {
         "metric": "arxiv_gcn_epoch_time", "value": None, "unit": "ms",
         "vs_baseline": None, "error": error,
     }
     out.update(state)  # keep any stage that DID finish
+    if _HEALTH is not None:
+        role = "supervisor" if "supervisor" in _HEALTH.component else "child"
+        out.setdefault("run_health", {})[role] = _HEALTH.finish(error, wedge)
     return out, (EXIT_PARTIAL if state.get("value") else empty_rc)
 
 
-def _emit_json_and_exit(error: str, empty_rc: int):
+def _emit_json_and_exit(error: str, empty_rc: int, wedge=None):
     """Child-side abnormal exit: ONE structured JSON line with whatever
     stages did finish (r1+r2 both died as rc=1 tracebacks with parsed:null
-    — that class of loss is designed out)."""
-    out, rc = _failure_json(error, _PARTIAL, empty_rc)
+    — that class of loss is designed out). Emit sites that KNOW their
+    wedge class pass it explicitly so classification never depends on
+    substring-matching the error prose (obs.health.classify_wedge stays
+    the fallback for sites that don't)."""
+    out, rc = _failure_json(error, _PARTIAL, empty_rc, wedge)
     print(json.dumps(out))
     sys.stdout.flush()
     os._exit(rc)
@@ -597,7 +633,7 @@ def _arm_watchdog():
     def _bail(signum, frame):
         _emit_json_and_exit(
             f"watchdog: incomplete within {budget}s (wedged TPU lease?)",
-            EXIT_EMPTY,
+            EXIT_EMPTY, wedge="watchdog_timeout",
         )
 
     signal.signal(signal.SIGALRM, _bail)
@@ -645,9 +681,12 @@ def _init_backend_fail_fast():
             if want and got != want:
                 # the wrong backend is now CACHED in-process; retrying
                 # can't fix it — fail structured, immediately
+                if _HEALTH is not None:
+                    _HEALTH.backend = {"platform": got, "expected": want}
                 _emit_json_and_exit(
                     f"backend is '{got}', need '{want}' (silent CPU "
-                    f"fallback from a wedged lease?)", EXIT_BACKEND)
+                    f"fallback from a wedged lease?)", EXIT_BACKEND,
+                    wedge="backend_lost")
             log(f"devices ({got}): {devs}")
             return
         except Exception as e:  # noqa: BLE001
@@ -656,9 +695,13 @@ def _init_backend_fail_fast():
                 f"{last.splitlines()[0]}")
             if attempt == 1:
                 time.sleep(5)
+    if _HEALTH is not None:
+        # do NOT re-probe via snapshot_backend here: on a wedged lease
+        # another jax.devices() can hang past the watchdog's reach
+        _HEALTH.backend = {"error": last}
     _emit_json_and_exit(
         f"backend init failed (fail-fast; supervisor respawns): {last}",
-        EXIT_BACKEND,
+        EXIT_BACKEND, wedge="backend_lost",
     )
 
 
@@ -679,12 +722,17 @@ def _hbm_peak_gb():
 
 
 def _child_main():
+    global _HEALTH
+
     t_start = time.time()
+    _HEALTH = _health_mod().RunHealth.begin("bench.child")
     _arm_watchdog()
     log("importing jax...")
     import jax  # noqa: F401
 
     _init_backend_fail_fast()
+    # backend is up: record the topology the numbers were measured on
+    _HEALTH.snapshot_backend()
 
     from dgraph_tpu import config as cfg
 
@@ -725,7 +773,7 @@ def _child_main():
         dt_ms, roof = bench_gcn(dtype_name)
     except Exception as e:  # emit JSON, never a bare traceback
         _emit_json_and_exit(f"gcn stage failed: {type(e).__name__}: {e}",
-                            EXIT_EMPTY)
+                            EXIT_EMPTY, wedge="stage_failure")
     hbm_gcn = _hbm_peak_gb()
     log(f"gcn epoch time {dt_ms:.2f} ms {roof} hbm_peak={hbm_gcn} GB")
     smoke = os.environ.get("DGRAPH_BENCH_SMOKE") == "1"
@@ -807,6 +855,9 @@ def _child_main():
             # chip measurement (platform guard is disabled in smoke mode)
         },
         "wall_s": round(time.time() - t_start, 1),
+        # a healthy run records its health too: the artifact documents the
+        # topology/config the numbers came from, not only failures
+        "run_health": {"child": _HEALTH.finish()},
     }
     print(json.dumps(out))
     if dt_ms != dt_ms:  # NaN: tunnel never produced a positive delta
@@ -815,8 +866,8 @@ def _child_main():
         sys.exit(EXIT_PARTIAL)  # GCN done but the GraphCast stage was lost
 
 
-def _supervisor_emit(state: dict, error: str) -> int:
-    out, rc = _failure_json(error, state, EXIT_EMPTY)
+def _supervisor_emit(state: dict, error: str, wedge=None) -> int:
+    out, rc = _failure_json(error, state, EXIT_EMPTY, wedge)
     print(json.dumps(out))
     sys.stdout.flush()
     return rc
@@ -834,8 +885,11 @@ def main() -> int:
     import signal
     import tempfile
 
+    global _HEALTH
+
     budget = int(os.environ.get("DGRAPH_BENCH_TIMEOUT", "2400"))
     deadline = time.time() + budget
+    _HEALTH = _health_mod().RunHealth.begin("bench.supervisor")
     with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
         state_path = f.name
 
@@ -858,7 +912,8 @@ def main() -> int:
         if p is not None and p.poll() is None:
             p.kill()
         rc = _supervisor_emit(
-            read_state(), f"supervisor received signal {signum}")
+            read_state(), f"supervisor received signal {signum}",
+            wedge="interrupted")
         try:
             os.unlink(state_path)  # os._exit skips the finally block
         except OSError:
@@ -874,7 +929,8 @@ def main() -> int:
     except Exception as e:  # the LAST unstructured exit path: even an
         # unexpected supervisor bug must not cost the round's JSON
         return _supervisor_emit(
-            read_state(), f"supervisor crashed: {type(e).__name__}: {e}")
+            read_state(), f"supervisor crashed: {type(e).__name__}: {e}",
+            wedge="unknown")
     finally:
         try:
             os.unlink(state_path)
@@ -909,6 +965,7 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
     attempt = 0
     while True:
         attempt += 1
+        t_probe = time.time()
         try:
             pp = subprocess.Popen(probe, stdout=subprocess.DEVNULL,
                                   stderr=subprocess.PIPE, text=True)
@@ -917,14 +974,21 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
                 timeout=min(150, max(5, phase1_end - time.time())))
             if pp.returncode == 0:
                 log(f"backend probe OK (attempt {attempt})")
+                _HEALTH.record_probe(attempt, time.time() - t_probe, "ok")
                 break
             tail = (perr or "").strip().splitlines()
             log(f"backend probe attempt {attempt} rc={pp.returncode}: "
                 f"{tail[-1] if tail else '?'}")
+            _HEALTH.record_probe(
+                attempt, time.time() - t_probe, "error",
+                f"rc={pp.returncode}: {tail[-1] if tail else '?'}")
         except subprocess.TimeoutExpired:
             pp.kill()
             pp.communicate()
             log(f"backend probe attempt {attempt} hung (wedged lease)")
+            _HEALTH.record_probe(
+                attempt, time.time() - t_probe, "hang",
+                "probe hung (wedged lease)")
         finally:
             child_proc[0] = None
         if time.time() >= phase1_end:
@@ -961,7 +1025,8 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
             p.communicate()
             return _supervisor_emit(
                 read_state(),
-                "bench child hung past its own watchdog; killed")
+                "bench child hung past its own watchdog; killed",
+                wedge="dispatch_wedge")
         last = (stdout or "").strip().splitlines()
         if (p.returncode == EXIT_BACKEND
                 and time.time() < deadline - 120):
@@ -969,9 +1034,20 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
                 f"respawning with {int(deadline - time.time())}s left")
             time.sleep(30)
             continue
-        # pass through the child's JSON line + rc when it produced one
+        # pass through the child's JSON line + rc when it produced one,
+        # merging the supervisor's probe history into its run_health so
+        # the artifact records the whole path onto the chip (the
+        # "seven wedged-lease probes" class of context, BENCH_r05)
         if last:
-            print(last[-1])
+            line = last[-1]
+            try:
+                out = json.loads(line)
+                out.setdefault("run_health", {})["supervisor"] = (
+                    _HEALTH.finish())
+                line = json.dumps(out)
+            except ValueError:
+                pass  # not JSON: pass the child's words through untouched
+            print(line)
             sys.stdout.flush()
             return p.returncode
         return _supervisor_emit(

@@ -84,6 +84,12 @@ def halo_exchange(
     """
     F = x.shape[-1]
     W, S = halo.send_idx.shape[0], halo.s_pad
+    if axis_name is not None and deltas is not None and len(deltas) == 0:
+        # no live cross-rank traffic anywhere in the mesh (send_mask is
+        # all-zero): the exchange is identically zero, so skip the padded
+        # collective entirely — this is what makes pick_halo_impl's
+        # 'none' verdict (and obs.footprint's 0-byte accounting) truthful
+        return jnp.zeros((W * S, F), x.dtype)
     if axis_name is None:
         # mask in x's dtype: the plan stores send_mask as f32, and a raw
         # multiply silently upcasts a bf16 stream — which then upcasts the
@@ -131,6 +137,9 @@ def halo_scatter_sum(
     """
     W, S = halo.send_idx.shape[0], halo.s_pad
     F = h.shape[-1]
+    if axis_name is not None and deltas is not None and len(deltas) == 0:
+        # transpose of the empty exchange: no halo slot maps anywhere
+        return jnp.zeros((n_pad, F), h.dtype)
     if axis_name is not None and _use_ppermute(axis_name, deltas):
         me = lax.axis_index(axis_name)
         out = jnp.zeros((n_pad, F), h.dtype)

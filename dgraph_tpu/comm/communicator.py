@@ -36,6 +36,12 @@ from dgraph_tpu.comm import collectives
 from dgraph_tpu.comm.mesh import GRAPH_AXIS, REPLICA_AXIS
 from dgraph_tpu.plan import EdgePlan, HaloSpec
 
+# Every collective issued through the facade carries a named region so
+# Perfetto traces (utils.timing.trace_to) attribute wire time to the API
+# call that caused it (collectives.py annotates the primitive layer the
+# same way).
+from dgraph_tpu.utils.timing import named_scope as _scoped
+
 
 @dataclasses.dataclass(frozen=True)
 class _BaseComm:
@@ -90,6 +96,7 @@ class _BaseComm:
             edata, bias, plan, side, self.graph_axis, edge_weight
         )
 
+    @_scoped("dgraph.comm.put")
     def put(self, send: jax.Array) -> jax.Array:
         """Deliver per-peer blocks by offsets — the ``BackendEngine.put``
         contract (``Engine.py:67-86``): two-sided backends alltoallv the
@@ -112,6 +119,7 @@ class _BaseComm:
         recv = lax.all_to_all(send, self.graph_axis, split_axis=0, concat_axis=0)
         return recv.reshape(W * S, F)
 
+    @_scoped("dgraph.comm.seq_attention")
     def seq_attention(self, q, k, v, *, causal: bool = False, kv_mask=None,
                       impl: str = "ring"):
         """Exact attention over the axis-sharded token/vertex dimension.
@@ -158,21 +166,25 @@ class _BaseComm:
         )
 
     # -- reductions over mesh axes --
+    @_scoped("dgraph.comm.all_reduce_sum")
     def all_reduce_sum(self, x):
         if self.graph_axis is None:
             return x
         return lax.psum(x, self.graph_axis)
 
+    @_scoped("dgraph.comm.all_reduce_mean")
     def all_reduce_mean(self, x):
         if self.graph_axis is None:
             return x
         return lax.pmean(x, self.graph_axis)
 
+    @_scoped("dgraph.comm.replica_mean")
     def replica_mean(self, x):
         if self.replica_axis is None:
             return x
         return lax.pmean(x, self.replica_axis)
 
+    @_scoped("dgraph.comm.grad_sync")
     def grad_sync(self, grads):
         """Gradient synchronization — the DDP all-reduce equivalent
         (``experiments/OGB/main.py:111-112``): SUM over the graph axis (each

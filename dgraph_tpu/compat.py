@@ -1,0 +1,100 @@
+"""JAX version compatibility shims.
+
+The codebase targets the jax >= 0.6 public API (``jax.shard_map``,
+``jax.set_mesh``); some environments (including this one) pin jax 0.4.x,
+where the same machinery lives at ``jax.experimental.shard_map.shard_map``
+(with ``check_rep`` instead of ``check_vma``) and an ambient mesh is
+entered via the ``Mesh`` context manager. Importing :mod:`dgraph_tpu`
+installs forward-compatible aliases onto the ``jax`` module so every call
+site — library, experiments, and tests — can use the one modern spelling.
+
+On a modern jax this module is a no-op; the shims only fill attributes
+that are absent, never replace existing ones.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _shard_map_04x(f=None, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    """``jax.shard_map`` signature on top of 0.4.x experimental shard_map.
+
+    Differences bridged: keyword-only ``mesh``; ``check_vma`` (0.6 name for
+    the replication/varying-manual-axes check) forwards to ``check_rep``;
+    bare-decorator form (``f=None``) returns a partial like 0.6 does.
+    """
+    from jax.experimental.shard_map import shard_map as _sm
+
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    if f is None:
+        return lambda g: _sm(
+            g, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def jax_version() -> tuple:
+    try:
+        return tuple(int(p) for p in jax.__version__.split(".")[:2])
+    except ValueError:  # dev/dirty version strings: assume modern
+        return (99, 0)
+
+
+# jax < 0.6: shard_map has no varying-manual-axes (vma) tracking, so an
+# in-body ``jax.grad`` of replicated (in_specs P()) params yields PER-SHARD
+# partial grads — the automatic psum the 0.6+ pvary-transpose inserts never
+# happens, and out_specs P() either trips check_rep or silently returns one
+# shard's partials. Training bodies must psum such grads explicitly there.
+EXPLICIT_INBODY_GRAD_PSUM = jax_version() < (0, 6)
+
+
+def sync_inbody_grads(grads, axis_names):
+    """psum in-body grads of replicated params over the axes the loss is
+    sharded on. Identity on jax >= 0.6 (vma tracking already inserted the
+    psum; an explicit one would double-count by the axis size)."""
+    if not EXPLICIT_INBODY_GRAD_PSUM:
+        return grads
+    from jax import lax
+
+    return jax.tree.map(lambda g: lax.psum(g, axis_names), grads)
+
+
+# shard_map kwargs that relax the replication checker where 0.4.x's
+# rep tracking raises false positives (e.g. "branches of cond produced
+# mismatched replication types" when AD re-traces ring attention's
+# causal lax.cond). Empty on jax >= 0.6, whose vma system tracks these
+# correctly — sprinkle ONLY at call sites with fully sharded out_specs,
+# where the checker protects nothing.
+RELAXED_CHECKS = {"check_vma": False} if jax_version() < (0, 6) else {}
+
+
+def _pcast_04x(t, axis_name, *, to="varying"):
+    """0.4.x has no vma system, so there is no device-varying type to cast
+    to; the rep-tracking rewrite handles broadcasts itself. Identity."""
+    del axis_name, to
+    return t
+
+
+def _set_mesh_04x(mesh):
+    """``jax.set_mesh`` context form on 0.4.x: the ``Mesh`` object is its
+    own context manager, and every shard_map here passes ``mesh=``
+    explicitly, so entering the physical mesh context is all the ambient
+    state the library needs."""
+    return mesh
+
+
+def install() -> None:
+    """Idempotently fill missing jax attributes (called on package import)."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_04x
+    if not hasattr(jax, "set_mesh"):
+        jax.set_mesh = _set_mesh_04x
+    from jax import lax
+
+    if not hasattr(lax, "pcast"):
+        lax.pcast = _pcast_04x
+
+
+install()

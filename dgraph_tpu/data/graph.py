@@ -57,11 +57,13 @@ class DistributedGraph:
         add_symmetric_norm: bool = False,
         pad_multiple: int = 8,
         seed: int = 0,
+        partition_kwargs: Optional[dict] = None,
     ) -> "DistributedGraph":
         num_nodes = features.shape[0]
         edge_index = np.asarray(edge_index)
         new_edges, ren = pt.partition_graph(
-            edge_index, num_nodes, world_size, method=partition_method, seed=seed
+            edge_index, num_nodes, world_size, method=partition_method,
+            seed=seed, **(partition_kwargs or {}),
         )
         plan, layout = build_edge_plan(
             new_edges,

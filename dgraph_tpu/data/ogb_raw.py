@@ -31,6 +31,11 @@ Per-dataset metadata that ogb keeps in its package-internal ``master.csv``
 (split type, add_inverse_edge, which side files exist) is inlined in
 ``NODE_DATASET_META`` — the raw download does not carry it.
 
+One deliberate divergence: ``add_inverse_edge`` APPENDS the reversed edges
+after the originals, where ogb's ``read_csv_graph_raw`` interleaves them
+per edge. Same edge set, different element order — see the note at the
+doubling site in :func:`read_node_pred_raw`.
+
 The writer (:func:`write_node_pred_raw`) emits the same bytes ogb's
 pipeline does (pandas ``to_csv(header=False, index=False)`` + gzip), so
 fixture tests exercise the identical parse the real download will get.
@@ -200,6 +205,16 @@ def read_node_pred_raw(root: str, name: str) -> tuple[dict, np.ndarray, dict]:
         )
 
     if meta["add_inverse_edge"]:
+        # Reversed edges are APPENDED as one block — the result is
+        # ``[e_0..e_{E-1}, rev(e_0)..rev(e_{E-1})]``. ogb's own
+        # ``read_csv_graph_raw`` INTERLEAVES instead (``np.repeat(...,2)``
+        # + odd-column swap -> ``[e_0, rev(e_0), e_1, rev(e_1), ...]``).
+        # The edge SET (and edge_feat pairing) is identical; element
+        # ORDER is not — never rely on column-order parity between this
+        # reader and a package-produced npz artifact. Pinned by
+        # tests/test_ogb_raw.py::test_add_inverse_edge_appends_not_
+        # interleaves. Everything downstream (plan build) treats the edge
+        # list as a set, so the cheaper append layout wins.
         graph["edge_index"] = np.concatenate(
             [graph["edge_index"], graph["edge_index"][::-1]], axis=1
         )

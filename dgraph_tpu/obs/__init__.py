@@ -1,0 +1,33 @@
+"""Unified observability layer.
+
+Three pillars, one import:
+
+- :mod:`dgraph_tpu.obs.footprint` — static comm-traffic accounting: walk an
+  :class:`~dgraph_tpu.plan.EdgePlan` and report per-collective bytes, shard
+  imbalance, and an analytic ICI/HBM roofline before a single step runs.
+  Also a CLI: ``python -m dgraph_tpu.obs.footprint``.
+- :mod:`dgraph_tpu.obs.metrics` — runtime metrics: a host-side
+  :class:`Metrics` registry (counters/gauges/histograms) and the
+  :class:`StepMetrics` aux-pytree the jitted train step threads out
+  (loss, grad-norm, mask counts), emitted as one structured JSONL record
+  per step through :class:`~dgraph_tpu.utils.logging.ExperimentLog`.
+- :mod:`dgraph_tpu.obs.health` — run/probe health diagnostics: the
+  structured :class:`RunHealth` record (probe attempts, wall-times, backend
+  state, wedge classification, topology snapshot) bench.py and the
+  experiment CLIs embed in their artifacts, so a null benchmark is
+  diagnosable from the JSON alone.
+"""
+
+from dgraph_tpu.obs.footprint import plan_footprint
+from dgraph_tpu.obs.health import RunHealth, classify_wedge, startup_record
+from dgraph_tpu.obs.metrics import Metrics, StepMetrics, default_registry
+
+__all__ = [
+    "plan_footprint",
+    "RunHealth",
+    "classify_wedge",
+    "startup_record",
+    "Metrics",
+    "StepMetrics",
+    "default_registry",
+]

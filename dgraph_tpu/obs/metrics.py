@@ -1,0 +1,148 @@
+"""Runtime metrics: host-side registry + the per-step aux pytree.
+
+Two pieces with one rule — observability must cost nothing when off:
+
+- :class:`Metrics`: a plain host-side registry of counters, gauges, and
+  histograms (plan-build walltimes, cache hits, probe retries…).  Never
+  traced; safe to call anywhere.
+- :class:`StepMetrics`: the aux pytree a jitted train step returns when
+  built with ``step_metrics=True`` (``train.loop.make_train_step``).  The
+  flag is a Python build-time constant, so the disabled step traces to the
+  byte-identical program it always had — zero device overhead and zero
+  extra recompiles (pinned by tests/test_obs.py's cache-hit assertion).
+
+One step -> one JSONL record: ``StepMetrics.record()`` coerces device
+scalars to floats and stamps the schema, ``ExperimentLog.write`` appends
+it.  ``StepMetrics.from_record`` round-trips the schema for readers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from dgraph_tpu.plan import pytree_dataclass
+
+STEP_SCHEMA_VERSION = 1
+
+# fields serialized into / parsed out of a step record, in schema order
+_STEP_FIELDS = ("loss", "accuracy", "grad_norm", "mask_count")
+
+
+@pytree_dataclass
+class StepMetrics:
+    """Aux pytree threaded out of the jitted train step.
+
+    Leaves are device scalars inside jit; ``record()`` is the host-side
+    exit point. Unset fields (None) vanish from the pytree and the record
+    — a model without a mask simply never reports ``mask_count``.
+    """
+
+    loss: Any = None
+    accuracy: Any = None
+    grad_norm: Any = None
+    mask_count: Any = None
+
+    # dict-style access so call sites written against the legacy metrics
+    # dict (``m["loss"]``) take a StepMetrics unchanged
+    def __getitem__(self, key: str):
+        if key not in _STEP_FIELDS:
+            raise KeyError(key)
+        return getattr(self, key)
+
+    def record(self, **extra) -> dict:
+        """One JSONL-ready dict: floats only, schema-stamped. ``extra``
+        carries host-side context (step index, wall_ms, lr...)."""
+        out = {"kind": "step", "schema": STEP_SCHEMA_VERSION}
+        for name in _STEP_FIELDS:
+            v = getattr(self, name)
+            if v is not None:
+                out[name] = float(v)
+        out.update(extra)
+        return out
+
+    @classmethod
+    def from_record(cls, rec: dict) -> "StepMetrics":
+        """Inverse of :meth:`record` (reader side; extras are dropped)."""
+        if rec.get("kind") != "step":
+            raise ValueError(f"not a step record: kind={rec.get('kind')!r}")
+        return cls(**{k: rec[k] for k in _STEP_FIELDS if k in rec})
+
+
+class _Histogram:
+    __slots__ = ("values",)
+
+    def __init__(self):
+        self.values: list = []
+
+    def observe(self, v: float) -> None:
+        self.values.append(float(v))
+
+    def snapshot(self) -> dict:
+        import numpy as np
+
+        if not self.values:
+            return {"count": 0}
+        a = np.asarray(self.values)
+        return {
+            "count": int(a.size),
+            "mean": float(a.mean()),
+            "min": float(a.min()),
+            "max": float(a.max()),
+            "p50": float(np.percentile(a, 50)),
+            "p95": float(np.percentile(a, 95)),
+        }
+
+
+class Metrics:
+    """Host-side metrics registry. Not thread-safe by design (the training
+    driver is single-threaded); snapshot() is JSON-ready."""
+
+    def __init__(self):
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._histograms: dict = {}
+
+    def counter(self, name: str, inc: float = 1.0) -> float:
+        self._counters[name] = self._counters.get(name, 0.0) + float(inc)
+        return self._counters[name]
+
+    def gauge(self, name: str, value: float) -> None:
+        self._gauges[name] = float(value)
+
+    def histogram(self, name: str, value: float) -> None:
+        self._histograms.setdefault(name, _Histogram()).observe(value)
+
+    def snapshot(self) -> dict:
+        return {
+            "counters": dict(self._counters),
+            "gauges": dict(self._gauges),
+            "histograms": {k: h.snapshot() for k, h in self._histograms.items()},
+        }
+
+    def reset(self) -> None:
+        self._counters.clear()
+        self._gauges.clear()
+        self._histograms.clear()
+
+
+default_registry = Metrics()
+
+
+def step_record(
+    metrics,
+    *,
+    step: int,
+    wall_ms: Optional[float] = None,
+    **extra,
+) -> dict:
+    """Record-builder that takes either a :class:`StepMetrics` or the
+    legacy metrics dict, so experiments can log one schema regardless of
+    which form their step returns."""
+    if not isinstance(metrics, StepMetrics):
+        metrics = StepMetrics(
+            **{k: metrics[k] for k in _STEP_FIELDS if k in metrics}
+        )
+    if wall_ms is not None:
+        extra["wall_ms"] = round(float(wall_ms), 3)
+    return metrics.record(step=int(step), **extra)

@@ -20,6 +20,7 @@ TPU-first deltas:
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import numpy as np
 
@@ -370,12 +371,29 @@ def partition_graph(
     world_size: int,
     method: str = "rcm",
     seed: int = 0,
+    *,
+    sample_frac: Optional[float] = None,
+    edge_balance: Optional[float] = None,
 ) -> tuple[np.ndarray, Renumbering]:
     """Partition + renumber in one call.
 
     Returns (renumbered_edge_index [2, E], renumbering). Edge endpoints are
     remapped into the new contiguous numbering; edge order is preserved.
+
+    ``sample_frac`` / ``edge_balance`` tune ``method="multilevel_sampled"``
+    (the full-scale papers100M settings are 0.35 / 1.0 — BASELINE.md /
+    scripts/p100m_r5_stages.py, now reachable through this standard API
+    instead of only the staged script, ADVICE r5). Passing either with any
+    other method raises: the knob would be silently ignored, and a "tuned"
+    run that never saw its tuning is the worst kind of benchmark.
     """
+    if method != "multilevel_sampled" and (
+        sample_frac is not None or edge_balance is not None
+    ):
+        raise ValueError(
+            f"sample_frac/edge_balance only apply to method="
+            f"'multilevel_sampled', got method={method!r}"
+        )
     if method == "round_robin":
         part = round_robin_partition(num_nodes, world_size)
     elif method == "block":
@@ -391,8 +409,13 @@ def partition_graph(
     elif method == "multilevel_big":
         part = multilevel_big_partition(edge_index, num_nodes, world_size, seed)
     elif method == "multilevel_sampled":
+        kw = {}
+        if sample_frac is not None:
+            kw["sample_frac"] = sample_frac
+        if edge_balance is not None:
+            kw["edge_balance"] = edge_balance
         part = multilevel_sampled_partition(
-            edge_index, num_nodes, world_size, seed
+            edge_index, num_nodes, world_size, seed, **kw
         )
     else:
         raise ValueError(f"unknown partition method: {method!r}")

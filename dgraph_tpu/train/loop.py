@@ -20,7 +20,9 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from dgraph_tpu import compat as _compat
 from dgraph_tpu.comm.mesh import GRAPH_AXIS, REPLICA_AXIS, plan_in_specs, squeeze_plan
+from dgraph_tpu.obs.metrics import StepMetrics
 from dgraph_tpu.plan import EdgePlan
 
 
@@ -107,9 +109,17 @@ def make_train_step(
     donate: bool = True,
     per_replica_batch: bool = False,
     batch_args: Callable = None,
+    step_metrics: bool = False,
 ):
     """Build a jitted SPMD train step: (params, opt_state, batch, plan) ->
     (params, opt_state, metrics).
+
+    ``step_metrics=True`` returns a :class:`~dgraph_tpu.obs.metrics.
+    StepMetrics` aux-pytree (loss, accuracy, grad_norm, mask_count) instead
+    of the bare dict; the flag is a BUILD-time constant, so the default
+    step's traced program is byte-identical to the flag not existing —
+    zero overhead and zero extra recompiles when disabled (pinned by
+    tests/test_obs.py).
 
     ``batch`` is a dict pytree with leading-[W] leaves (from
     ``DistributedGraph.batch`` + labels); params/opt_state are replicated.
@@ -157,23 +167,35 @@ def make_train_step(
             return loss / num_replicas, (loss, correct)
 
         (_, (loss, correct)), grads = jax.value_and_grad(lf, has_aux=True)(params)
-        # NO explicit grad psum: params enter replicated (in_specs P()), and
-        # shard_map's vma tracking makes grad-of-replicated-input insert the
-        # cross-shard psum automatically (the transpose of the replicated
-        # broadcast). An extra lax.psum here would double-count by W —
-        # pinned by tests/test_models.py::test_distributed_gradients_match_
-        # single_device.
+        # NO explicit grad psum on jax >= 0.6: params enter replicated
+        # (in_specs P()), and shard_map's vma tracking makes
+        # grad-of-replicated-input insert the cross-shard psum
+        # automatically (the transpose of the replicated broadcast) — an
+        # extra lax.psum there would double-count by W. On jax 0.4.x no
+        # such rewrite exists, so compat inserts the psum explicitly over
+        # exactly the axes the batch is sharded on. Pinned either way by
+        # tests/test_models.py::test_distributed_gradients_match_single_
+        # device.
+        # BOTH axes unconditionally: params are replicated over replica
+        # too, and with the loss pre-scaled by 1/num_replicas the replica
+        # psum is exactly the DDP mean (with per_replica_batch=False the
+        # replica grads are identical, so sum/R reproduces them; a
+        # graph-only psum would leave grads scaled 1/R when R > 1)
+        grads = _compat.sync_inbody_grads(grads, (REPLICA_AXIS, GRAPH_AXIS))
         loss = lax.psum(loss, GRAPH_AXIS)
-        acc = lax.psum(correct, GRAPH_AXIS) / jnp.maximum(
-            lax.psum(b["mask"].sum(), GRAPH_AXIS), 1.0
-        )
+        mask_count = lax.psum(b["mask"].sum(), GRAPH_AXIS)
+        acc = lax.psum(correct, GRAPH_AXIS) / jnp.maximum(mask_count, 1.0)
         if per_replica_batch:
             # distinct samples: report the replica-mean metrics (out_specs
             # P() requires values statically replicated over the replica
             # axis — also when its size is 1)
             loss = lax.pmean(loss, REPLICA_AXIS)
             acc = lax.pmean(acc, REPLICA_AXIS)
-        return grads, {"loss": loss, "accuracy": acc}
+            mask_count = lax.pmean(mask_count, REPLICA_AXIS)
+        out = {"loss": loss, "accuracy": acc}
+        if step_metrics:
+            out["mask_count"] = mask_count
+        return grads, out
 
     def step(params, opt_state, batch, plan):
         batch_specs = jax.tree.map(lambda _: batch_spec, batch)
@@ -185,6 +207,13 @@ def make_train_step(
         )(params, batch, plan)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if step_metrics:
+            metrics = StepMetrics(
+                loss=metrics["loss"],
+                accuracy=metrics["accuracy"],
+                grad_norm=optax.global_norm(grads),
+                mask_count=metrics["mask_count"],
+            )
         return params, opt_state, metrics
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())

@@ -21,6 +21,17 @@ def is_lead_process() -> bool:
     return jax.process_index() == 0
 
 
+def _json_default(o):
+    """Coerce the scalar types experiment records actually contain (numpy
+    and jax device scalars/arrays) so one un-floated metric doesn't throw
+    away a whole record mid-run."""
+    if hasattr(o, "item") and getattr(o, "ndim", None) == 0:
+        return o.item()
+    if hasattr(o, "tolist"):
+        return o.tolist()
+    return str(o)
+
+
 class ExperimentLog:
     def __init__(self, path: str, echo: bool = True):
         self.path = path
@@ -33,7 +44,7 @@ class ExperimentLog:
     def write(self, record: dict) -> None:
         if not is_lead_process():
             return
-        line = json.dumps(record)
+        line = json.dumps(record, default=_json_default)
         with open(self.path, "a") as f:
             f.write(line + "\n")
         if self.echo:

@@ -46,7 +46,9 @@ class TimingReport:
 
     @classmethod
     @contextlib.contextmanager
-    def time(cls, name: str, sync_fn=None):
+    def time(cls, name: str):
+        """Context form; set ``result["sync"]`` to a jax value to make the
+        stop block on device completion."""
         cls.start(name)
         result = {}
         try:

@@ -41,6 +41,9 @@ class Config:
     # checkpoint + clean exit; a >0 deadline arms the per-step wedge
     # watchdog (exit 17 = restart+resume me)
     step_deadline_s: float = 0.0
+    # thread grad-norm through the jitted step and emit obs step records;
+    # build-time flag: False keeps the step byte-identical to before
+    step_metrics: bool = False
 
 
 def main(cfg: Config):
@@ -49,9 +52,12 @@ def main(cfg: Config):
     import optax
     from jax.sharding import PartitionSpec as P
 
+    from dgraph_tpu import compat as _compat
     from dgraph_tpu.comm import Communicator, make_graph_mesh
     from dgraph_tpu.comm.mesh import GRAPH_AXIS, plan_in_specs, squeeze_plan
     from dgraph_tpu.data.weather import SyntheticWeatherDataset
+    from dgraph_tpu.obs import startup_record
+    from dgraph_tpu.obs.metrics import StepMetrics
     from dgraph_tpu.models.graphcast import GraphCast, build_graphcast_graphs
     from dgraph_tpu.train.checkpoint import (
         checkpoint_keys, restore_checkpoint, save_checkpoint)
@@ -63,6 +69,7 @@ def main(cfg: Config):
     mesh = make_graph_mesh(ranks_per_graph=world)
     comm = Communicator.init_process_group("tpu", world_size=world)
     log = ExperimentLog(cfg.log_path)
+    log.write(startup_record("experiments.graphcast_train"))
 
     TimingReport.start("graph_build")
     graphs = build_graphcast_graphs(cfg.mesh_level, cfg.num_lat, cfg.num_lon, world)
@@ -168,6 +175,9 @@ def main(cfg: Config):
             return se.sum() / jnp.maximum(cnt, 1.0)
 
         loss, grads = jax.value_and_grad(lf)(params)
+        # jax<0.6: in-body grads of replicated params need the explicit
+        # graph-axis psum (no-op on 0.6+, where vma tracking inserts it)
+        grads = _compat.sync_inbody_grads(grads, (GRAPH_AXIS,))
         return jax.lax.psum(loss, GRAPH_AXIS), grads
 
     body = jax.shard_map(
@@ -180,11 +190,14 @@ def main(cfg: Config):
     @jax.jit
     def step(params, opt_state, ema, x, y):
         loss, grads = body(params, x, y, gmask, statics, plans)
+        # build-time flag: the default (False) step is byte-identical to
+        # the un-instrumented program — no overhead, no extra recompiles
+        gn = optax.global_norm(grads) if cfg.step_metrics else None
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         if ema is not None:  # trace-time constant (pytree vs None)
             ema = ema_update(ema, params, cfg.ema_decay)
-        return params, opt_state, ema, loss
+        return params, opt_state, ema, StepMetrics(loss=loss, grad_norm=gn)
 
     if cfg.microbenchmark:
         _microbenchmark(model, params, statics, plans, mesh, comm, ds, log)
@@ -204,23 +217,20 @@ def main(cfg: Config):
             while step_idx < cfg.steps:
                 x, y = ds.get_sharded(step_idx)
                 t0 = time.perf_counter()
-                params, opt_state, ema, loss = step(
+                params, opt_state, ema, sm = step(
                     params, opt_state, ema, jnp.asarray(x), jnp.asarray(y))
-                jax.block_until_ready(loss)
+                jax.block_until_ready(sm.loss)
                 if dog is not None:
                     dog.beat()
                 dt = (time.perf_counter() - t0) * 1000
                 step_idx += 1
                 preempted = guard.should_stop()
                 if step_idx % 10 == 0 or step_idx == cfg.steps or preempted:
-                    log.write(
-                        {
-                            "step": step_idx,
-                            "loss": float(loss),
-                            "step_ms": round(dt, 2),
-                            "lr": float(schedule(step_idx)),
-                        }
-                    )
+                    log.write(sm.record(
+                        step=step_idx,
+                        step_ms=round(dt, 2),
+                        lr=float(schedule(step_idx)),
+                    ))
                 if cfg.ckpt_dir and (step_idx % cfg.save_freq == 0 or preempted):
                     # a long orbax write is not a wedged device — suspend
                     # the watchdog for the duration (elastic.py:_save)
@@ -272,7 +282,7 @@ def main(cfg: Config):
                     "rollout_eval": label, "steps": cfg.eval_rollout,
                     "rmse_per_step": [round(float(r), 5) for r in rmse],
                 })
-    log.write({"timing": __import__("dgraph_tpu.utils", fromlist=["TimingReport"]).TimingReport.report()})
+    log.write({"timing": TimingReport.report()})
 
 
 def _microbenchmark(model, params, statics, plans, mesh, comm, ds, log):

@@ -46,6 +46,9 @@ class Config:
     seed: int = 0
     log_path: str = "logs/long_context_lm.jsonl"
     log_every: int = 20
+    # thread grad-norm through the jitted step + emit obs step records
+    # (build-time flag; False = byte-identical un-instrumented step)
+    step_metrics: bool = False
 
 
 def main(cfg: Config):
@@ -57,6 +60,8 @@ def main(cfg: Config):
 
     from dgraph_tpu.comm import Communicator
     from dgraph_tpu.models.transformer import SeqTransformerLM
+    from dgraph_tpu.obs import startup_record
+    from dgraph_tpu.obs.metrics import StepMetrics
     from dgraph_tpu.utils import ExperimentLog
 
     W = cfg.world_size or len(jax.devices())
@@ -152,21 +157,23 @@ def main(cfg: Config):
             l, g = jax.value_and_grad(
                 lambda p, tk: loss_sm(p, tk, pos)
             )(params, toks)
+            # build-time flag: False traces the exact un-instrumented step
+            gn = optax.global_norm(g) if cfg.step_metrics else None
             updates, opt_state = opt.update(g, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, l
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, StepMetrics(loss=l, grad_norm=gn)
 
         log = ExperimentLog(cfg.log_path)
+        log.write(startup_record("experiments.long_context_lm"))
         uniform = float(np.log(cfg.vocab))
         t0 = time.perf_counter()
         for i in range(cfg.steps):
-            params, opt_state, l = step(params, opt_state, batch())
+            params, opt_state, sm = step(params, opt_state, batch())
             if i % cfg.log_every == 0 or i == cfg.steps - 1:
-                rec = {
-                    "step": i, "loss": float(l), "uniform_nats": uniform,
-                    "seq_len": T, "world": W,
-                    "ms_per_step": (time.perf_counter() - t0) / (i + 1) * 1e3,
-                }
-                log.write(rec)
+                log.write(sm.record(
+                    step=i, uniform_nats=uniform, seq_len=T, world=W,
+                    ms_per_step=(time.perf_counter() - t0) / (i + 1) * 1e3,
+                ))
 
 
 if __name__ == "__main__":

@@ -26,6 +26,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# jax-version shims (jax.shard_map / jax.set_mesh on 0.4.x) must be in
+# place before test modules that use the modern spellings are imported.
+import dgraph_tpu.compat  # noqa: E402,F401
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -44,6 +44,14 @@ def test_backend_failure_emits_json_and_rc3():
     assert out["metric"] == "arxiv_gcn_epoch_time"
     assert out["value"] is None
     assert "error" in out
+    # the failure artifact must be diagnosable ALONE: a populated
+    # RunHealth record with the probe history and a wedge classification
+    # (obs.health) — not just free text (the BENCH_r05 lesson)
+    rh = out["run_health"]["supervisor"]
+    assert rh["probes"], rh
+    assert all(p["outcome"] in ("ok", "error", "hang") for p in rh["probes"])
+    assert rh["wedge"] in ("init_failure", "init_wedge"), rh["wedge"]
+    assert rh["schema"] == 1 and rh["host"]["hostname"]
 
 
 @pytest.mark.slow
@@ -62,3 +70,10 @@ def test_smoke_run_complete_rc0():
     assert out["value"] is not None and out["value"] > 0
     assert out["graphcast_step_ms"] is not None
     assert out["config"]["dtype"] == "bfloat16"
+    # healthy runs carry their health too: child topology snapshot +
+    # supervisor probe history, wedge 'none' on both
+    rh = out["run_health"]
+    assert rh["child"]["backend"]["platform"] == "cpu"
+    assert rh["child"]["wedge"] == "none"
+    assert rh["supervisor"]["probes"][-1]["outcome"] == "ok"
+    assert rh["supervisor"]["wedge"] == "none"

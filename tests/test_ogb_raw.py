@@ -68,6 +68,38 @@ def test_arxiv_csv_layout_roundtrips_exactly(tmp_path):
         np.testing.assert_array_equal(got, t["split_idx"][split])
 
 
+def test_add_inverse_edge_appends_not_interleaves(tmp_path):
+    """Pin the documented divergence from ogb's ``read_csv_graph_raw``:
+    reversed edges are APPENDED as one block ([fwd..., rev...]), NOT
+    interleaved per edge ([e0, rev(e0), e1, rev(e1), ...]) like the
+    package does. The edge SET matches the package either way; element
+    ORDER does not — nothing may rely on column-order parity with
+    package-produced npz artifacts."""
+    t = _toy(seed=3)
+    ogb_raw.write_node_pred_raw(
+        str(tmp_path), "ogbn-products",
+        edge_index=t["edge_index"], labels=t["labels"],
+        split_idx=t["split_idx"], node_feat=t["node_feat"],
+    )
+    graph, _, _ = ogb_raw.read_node_pred_raw(str(tmp_path), "ogbn-products")
+    E = t["edge_index"].shape[1]
+    got = graph["edge_index"]
+    assert got.shape == (2, 2 * E)
+    # appended layout: first block is the download order, second block is
+    # the reversal of the whole first block (same order, rows swapped)
+    np.testing.assert_array_equal(got[:, :E], t["edge_index"])
+    np.testing.assert_array_equal(got[:, E:], t["edge_index"][::-1])
+    # and explicitly NOT ogb's interleaved layout
+    interleaved = np.repeat(t["edge_index"], 2, axis=1)
+    interleaved[:, 1::2] = interleaved[::-1, 1::2]
+    assert not np.array_equal(got, interleaved)
+    # the edge SET still matches the package's
+    assert (
+        set(map(tuple, got.T.tolist()))
+        == set(map(tuple, interleaved.T.tolist()))
+    )
+
+
 def test_products_doubles_edges_like_master_csv(tmp_path):
     """ogbn-products ships single-direction edges; ogb's loader doubles
     them (master.csv add_inverse_edge) — the raw reader must too."""

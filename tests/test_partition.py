@@ -224,6 +224,36 @@ class TestMultilevelSampled:
         assert np.all(np.diff(ren.partition) >= 0)
         assert new_edges.max() < V
 
+    def test_partition_graph_plumbs_sampled_knobs(self):
+        """sample_frac/edge_balance reach multilevel_sampled through the
+        standard API (ADVICE r5: the measured-good full-scale settings,
+        0.35/1.0, were only reachable via scripts/p100m_r5_stages.py).
+        Equality with a direct multilevel_sampled_partition call at the
+        same seed pins that the values actually arrive."""
+        edge_index, V = TestMultilevel()._ring_of_cliques(8, 12)
+        _, ren = pt.partition_graph(
+            edge_index, V, 4, method="multilevel_sampled", seed=7,
+            sample_frac=0.35, edge_balance=1.0,
+        )
+        direct = pt.multilevel_sampled_partition(
+            edge_index, V, 4, seed=7, sample_frac=0.35, edge_balance=1.0
+        )
+        counts_direct = np.bincount(direct, minlength=4)
+        np.testing.assert_array_equal(np.sort(ren.counts), np.sort(counts_direct))
+        assert np.all(np.diff(ren.partition) >= 0)
+
+    def test_partition_graph_rejects_knobs_for_other_methods(self):
+        """Passing a sampled-only knob with a method that would silently
+        ignore it must raise — a 'tuned' run that never saw its tuning is
+        the failure mode the plumbing exists to prevent."""
+        edge_index, V = TestMultilevel()._ring_of_cliques(8, 12)
+        with pytest.raises(ValueError, match="multilevel_sampled"):
+            pt.partition_graph(edge_index, V, 4, method="rcm", sample_frac=0.5)
+        with pytest.raises(ValueError, match="multilevel_sampled"):
+            pt.partition_graph(
+                edge_index, V, 4, method="block", edge_balance=1.0
+            )
+
     def test_edge_balance_blend_reduces_edge_imbalance(self):
         """edge_balance trades a little vertex imbalance for owner-edge
         (dst in-degree) balance — the blend that shrinks e_pad on
