@@ -19,7 +19,6 @@ from dgraph_tpu import partition as pt
 from dgraph_tpu.plan import (
     EdgePlan,
     EdgePlanLayout,
-    build_edge_plan,
     shard_edge_data,
     shard_vertex_data,
 )
@@ -58,6 +57,7 @@ class DistributedGraph:
         pad_multiple: int = 8,
         seed: int = 0,
         partition_kwargs: Optional[dict] = None,
+        plan_cache_dir: str = "",
     ) -> "DistributedGraph":
         num_nodes = features.shape[0]
         edge_index = np.asarray(edge_index)
@@ -65,7 +65,12 @@ class DistributedGraph:
             edge_index, num_nodes, world_size, method=partition_method,
             seed=seed, **(partition_kwargs or {}),
         )
-        plan, layout = build_edge_plan(
+        # the on-disk plan cache (train/checkpoint.cached_edge_plan) resolves
+        # a falsy dir to a plain build, so this is the one call site either way
+        from dgraph_tpu.train.checkpoint import cached_edge_plan
+
+        plan, layout = cached_edge_plan(
+            plan_cache_dir,
             new_edges,
             ren.partition,
             world_size=world_size,

@@ -3,8 +3,9 @@
 Two pieces with one rule — observability must cost nothing when off:
 
 - :class:`Metrics`: a plain host-side registry of counters, gauges, and
-  histograms (plan-build walltimes, cache hits, probe retries…).  Never
-  traced; safe to call anywhere.
+  histograms with quantile snapshots (plan-build walltimes, cache hits,
+  serve latency percentiles…).  Never traced; safe to call anywhere,
+  including from the serve batcher's threads.
 - :class:`StepMetrics`: the aux pytree a jitted train step returns when
   built with ``step_metrics=True`` (``train.loop.make_train_step``).  The
   flag is a Python build-time constant, so the disabled step traces to the
@@ -69,61 +70,130 @@ class StepMetrics:
         return cls(**{k: rec[k] for k in _STEP_FIELDS if k in rec})
 
 
+# quantiles every histogram snapshot reports: the serving SLO trio
+DEFAULT_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def _q_label(q: float) -> str:
+    """0.5 -> 'p50', 0.95 -> 'p95', 0.999 -> 'p99.9'."""
+    return "p" + format(q * 100, "g")
+
+
 class _Histogram:
-    __slots__ = ("values",)
+    """Bounded-memory histogram: count/mean/min/max are exact running
+    aggregates; quantiles come from a fixed-size uniform reservoir
+    (Vitter's algorithm R, deterministic seed), so a serving process
+    observing millions of latencies holds at most ``MAX_SAMPLES`` floats
+    per histogram and a snapshot sort is O(MAX_SAMPLES log MAX_SAMPLES)
+    under the registry lock. Quantiles are exact until ``MAX_SAMPLES``
+    observations, then unbiased estimates."""
+
+    MAX_SAMPLES = 4096
+
+    __slots__ = ("count", "total", "vmin", "vmax", "values", "_rng")
 
     def __init__(self):
-        self.values: list = []
+        import random
+
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self.values: list = []  # uniform sample of the observations
+        self._rng = random.Random(0x5EED)
 
     def observe(self, v: float) -> None:
-        self.values.append(float(v))
+        v = float(v)
+        self.count += 1
+        self.total += v
+        self.vmin = v if v < self.vmin else self.vmin
+        self.vmax = v if v > self.vmax else self.vmax
+        if len(self.values) < self.MAX_SAMPLES:
+            self.values.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.MAX_SAMPLES:
+                self.values[j] = v
 
-    def snapshot(self) -> dict:
-        import numpy as np
-
+    def quantile(self, q: float) -> float:
+        """Empirical quantile with linear interpolation between order
+        statistics (numpy's default 'linear' method, so snapshots agree
+        with offline np.percentile analysis of the same JSONL). Raises
+        ValueError on an empty histogram or q outside [0, 1]."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
         if not self.values:
+            raise ValueError("quantile of an empty histogram")
+        s = sorted(self.values)
+        pos = q * (len(s) - 1)
+        lo = int(pos)
+        frac = pos - lo
+        if frac == 0.0:
+            return s[lo]
+        return s[lo] + (s[lo + 1] - s[lo]) * frac
+
+    def snapshot(self, quantiles: tuple = DEFAULT_QUANTILES) -> dict:
+        if not self.count:
             return {"count": 0}
-        a = np.asarray(self.values)
-        return {
-            "count": int(a.size),
-            "mean": float(a.mean()),
-            "min": float(a.min()),
-            "max": float(a.max()),
-            "p50": float(np.percentile(a, 50)),
-            "p95": float(np.percentile(a, 95)),
+        out = {
+            "count": self.count,
+            "mean": self.total / self.count,
+            "min": self.vmin,
+            "max": self.vmax,
         }
+        for q in quantiles:
+            out[_q_label(q)] = self.quantile(q)
+        return out
 
 
 class Metrics:
-    """Host-side metrics registry. Not thread-safe by design (the training
-    driver is single-threaded); snapshot() is JSON-ready."""
+    """Host-side metrics registry; snapshot() is JSON-ready. Guarded by one
+    lock so concurrent producers (the serve micro-batcher's worker thread +
+    client submit threads) can share a registry; the per-call cost is one
+    uncontended mutex, nothing on the device path."""
 
     def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
         self._counters: dict = {}
         self._gauges: dict = {}
         self._histograms: dict = {}
 
     def counter(self, name: str, inc: float = 1.0) -> float:
-        self._counters[name] = self._counters.get(name, 0.0) + float(inc)
-        return self._counters[name]
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + float(inc)
+            return self._counters[name]
 
     def gauge(self, name: str, value: float) -> None:
-        self._gauges[name] = float(value)
+        with self._lock:
+            self._gauges[name] = float(value)
 
     def histogram(self, name: str, value: float) -> None:
-        self._histograms.setdefault(name, _Histogram()).observe(value)
+        with self._lock:
+            self._histograms.setdefault(name, _Histogram()).observe(value)
 
-    def snapshot(self) -> dict:
-        return {
-            "counters": dict(self._counters),
-            "gauges": dict(self._gauges),
-            "histograms": {k: h.snapshot() for k, h in self._histograms.items()},
-        }
+    def quantile(self, name: str, q: float) -> float:
+        """Quantile of a recorded histogram (KeyError if it was never
+        observed) — the accessor serve latency percentiles read."""
+        with self._lock:
+            return self._histograms[name].quantile(q)
+
+    def snapshot(self, quantiles: tuple = DEFAULT_QUANTILES) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {
+                    k: h.snapshot(quantiles) for k, h in self._histograms.items()
+                },
+            }
 
     def reset(self) -> None:
-        self._counters.clear()
-        self._gauges.clear()
-        self._histograms.clear()
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
 
 
 default_registry = Metrics()

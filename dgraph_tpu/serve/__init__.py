@@ -1,0 +1,52 @@
+"""Online GNN inference serving over a partitioned graph.
+
+The training stack ends at ``make_eval_step``; this package is the path
+from a checkpoint to request/response inference at production latency on
+TPU, built on the one discipline that matters there — **no XLA compiles on
+the hot path**:
+
+- :mod:`~dgraph_tpu.serve.bucketing` — requests are padded up a small
+  geometric ladder of target-node-count buckets (:class:`BucketLadder`),
+  so every request shape is one of a handful compiled ahead of time.
+- :mod:`~dgraph_tpu.serve.engine` — :class:`ServeEngine` restores params
+  (``train.checkpoint.restore_checkpoint``), holds one jitted, donated
+  forward per bucket (the same ``train.loop.model_apply`` forward the
+  train/eval steps run), AOT-warms every bucket, and counts recompiles
+  (steady state == 0, pinned by ``--selftest``).
+- :mod:`~dgraph_tpu.serve.batcher` — :class:`MicroBatcher` coalesces
+  concurrent requests into one padded call: bounded queue with structured
+  backpressure (:class:`~dgraph_tpu.serve.errors.QueueFull`), bounded batch
+  delay, per-request deadlines.
+- :mod:`~dgraph_tpu.serve.health` — the ``serve_health`` JSONL record
+  (latency percentiles, queue state, recompile counter) riding the
+  :mod:`dgraph_tpu.obs` pipeline.
+
+CLI: ``python -m dgraph_tpu.serve --selftest`` is the single-process CPU
+end-to-end check; ``experiments/serve_bench.py`` is the closed-loop load
+generator.
+"""
+
+from dgraph_tpu.serve.batcher import MicroBatcher
+from dgraph_tpu.serve.bucketing import BucketLadder, pad_ids
+from dgraph_tpu.serve.engine import ServeEngine
+from dgraph_tpu.serve.errors import (
+    EngineStopped,
+    QueueFull,
+    RequestTimeout,
+    RequestTooLarge,
+    ServeError,
+)
+from dgraph_tpu.serve.health import serve_health_record
+
+__all__ = [
+    "BucketLadder",
+    "EngineStopped",
+    "MicroBatcher",
+    "QueueFull",
+    "RequestTimeout",
+    "RequestTooLarge",
+    "ServeEngine",
+    "ServeError",
+    "pad_ids",
+    "serve_health_record",
+]

@@ -1,0 +1,237 @@
+"""``python -m dgraph_tpu.serve`` — online GNN inference serving CLI.
+
+Default mode builds a serving stack over a synthetic (or npz) graph, warms
+every bucket, runs the requested traffic through the micro-batcher, and
+emits a ``serve_health`` JSONL record.
+
+``--selftest`` is the single-process CPU end-to-end check (registered as a
+tier-1 test): synthetic graph -> init params -> checkpoint save/restore
+round trip -> plan via the on-disk cache -> warmup -> mixed-size traffic
+through the micro-batcher -> hard assertions:
+
+- zero XLA compiles after warmup (``recompiles_since_warmup == 0``);
+- bucketed served logits == the full eval forward's logits **bit-for-bit**
+  (same params, same plan, same ``model_apply`` body);
+- an over-ladder request is rejected with the structured ``too_large``
+  error.
+
+Exit code 0 only if every assertion holds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import tempfile
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    """Online GNN inference serving (``--selftest`` for the CPU e2e check)."""
+
+    selftest: bool = False
+    # graph (synthetic SBM unless data_path points at an npz)
+    data_path: Optional[str] = None
+    num_nodes: int = 400
+    num_classes: int = 4
+    feat_dim: int = 16
+    avg_degree: float = 6.0
+    partition: str = "random"
+    world_size: int = 0  # 0 = all devices
+    # model
+    model: str = "gcn"  # gcn | sage
+    hidden: int = 16
+    num_layers: int = 2
+    seed: int = 0
+    # checkpoint / plan cache ("" = fresh params / no cache; selftest uses a
+    # tempdir for both so the restore + cache paths are always exercised)
+    ckpt_dir: str = ""
+    plan_cache: str = ""
+    # bucket ladder
+    min_bucket: int = 8
+    max_bucket: int = 64
+    growth: float = 2.0
+    # micro-batcher
+    max_batch_size: int = 8
+    max_delay_ms: float = 2.0
+    max_queue_depth: int = 64
+    request_timeout_s: float = 30.0
+    # traffic
+    requests: int = 32
+    log_path: str = "logs/serve.jsonl"
+
+
+def build_serving(cfg: Config):
+    """Graph -> params (checkpoint round trip if configured) -> warmed
+    engine + batcher. Shared by this CLI and experiments/serve_bench.py."""
+    import jax
+    import numpy as np
+
+    from dgraph_tpu.comm import Communicator, make_graph_mesh
+    from dgraph_tpu.data import DistributedGraph, synthetic
+    from dgraph_tpu.models import GCN, GraphSAGE
+    from dgraph_tpu.obs.metrics import Metrics
+    from dgraph_tpu.serve.batcher import MicroBatcher
+    from dgraph_tpu.serve.bucketing import BucketLadder
+    from dgraph_tpu.serve.engine import ServeEngine
+    from dgraph_tpu.train.checkpoint import save_checkpoint
+    from dgraph_tpu.train.loop import init_params
+
+    world = cfg.world_size or len(jax.devices())
+    mesh = make_graph_mesh(ranks_per_graph=world)
+    comm = Communicator.init_process_group("tpu", world_size=world)
+
+    if cfg.data_path:
+        z = np.load(cfg.data_path)
+        masks = {
+            k.removesuffix("_mask"): z[k] for k in z.files if k.endswith("_mask")
+        }
+        # OGB exports say "valid"; the split vocabulary here is "val" — the
+        # same rename experiments/ogb_gcn.py applies (keep in sync: a
+        # missed rename silently serves/evaluates on ALL vertices)
+        if "valid" in masks and "val" not in masks:
+            masks["val"] = masks.pop("valid")
+        data = {
+            "edge_index": z["edge_index"],
+            "features": z["features"],
+            "labels": z["labels"],
+            "masks": masks,
+            "num_classes": int(np.asarray(z["labels"]).max()) + 1,
+        }
+    else:
+        data = synthetic.sbm_classification_graph(
+            num_nodes=cfg.num_nodes,
+            num_classes=cfg.num_classes,
+            feat_dim=cfg.feat_dim,
+            avg_degree=cfg.avg_degree,
+            seed=cfg.seed,
+        )
+    g = DistributedGraph.from_global(
+        data["edge_index"],
+        data["features"],
+        data["labels"],
+        data["masks"],
+        world_size=world,
+        partition_method=cfg.partition,
+        add_symmetric_norm=cfg.model == "gcn",
+        plan_cache_dir=cfg.plan_cache,
+    )
+
+    C = data["num_classes"]
+    if cfg.model == "gcn":
+        model = GCN(cfg.hidden, C, comm=comm, num_layers=cfg.num_layers)
+    elif cfg.model == "sage":
+        model = GraphSAGE(cfg.hidden, C, comm=comm, num_layers=cfg.num_layers)
+    else:
+        raise SystemExit(f"unknown model {cfg.model}")
+
+    import jax.numpy as jnp
+
+    plan = jax.tree.map(jnp.asarray, g.plan)
+    batch = jax.tree.map(jnp.asarray, dict(g.batch("train"), y=g.labels))
+    params = init_params(model, mesh, plan, batch, seed=cfg.seed)
+
+    registry = Metrics()
+    ladder = BucketLadder.geometric(cfg.min_bucket, cfg.max_bucket, cfg.growth)
+    if cfg.ckpt_dir:
+        # serving restores from disk, never from in-process state. An EMPTY
+        # dir is seeded with the just-initialized params so the save ->
+        # restore round trip is exercised (the selftest path); a dir that
+        # already holds checkpoints is a REAL training artifact — never
+        # write into it, just serve its newest readable step.
+        from dgraph_tpu.train.checkpoint import latest_step
+
+        if latest_step(cfg.ckpt_dir) is None:
+            save_checkpoint(cfg.ckpt_dir, {"params": params, "step": 0}, 0)
+        engine = ServeEngine.from_checkpoint(
+            model, mesh, g, cfg.ckpt_dir, ladder=ladder, registry=registry,
+        )
+    else:
+        engine = ServeEngine.from_distributed_graph(
+            model, mesh, g, params, ladder=ladder, registry=registry,
+        )
+    batcher = MicroBatcher(
+        engine,
+        max_batch_size=cfg.max_batch_size,
+        max_delay_ms=cfg.max_delay_ms,
+        max_queue_depth=cfg.max_queue_depth,
+        default_timeout_s=cfg.request_timeout_s,
+        registry=registry,
+    )
+    return engine, batcher, g
+
+
+def main(cfg: Config) -> dict:
+    import numpy as np
+
+    from dgraph_tpu.obs.health import startup_record
+    from dgraph_tpu.serve.errors import RequestTooLarge
+    from dgraph_tpu.serve.health import serve_health_record
+    from dgraph_tpu.utils import ExperimentLog
+
+    log = ExperimentLog(cfg.log_path, echo=False)
+    log.write(startup_record("serve.cli"))
+
+    tmp = None
+    if cfg.selftest and not cfg.ckpt_dir:
+        tmp = tempfile.TemporaryDirectory(prefix="dgraph_serve_selftest_")
+        cfg.ckpt_dir = tmp.name + "/ckpt"
+        cfg.plan_cache = tmp.name + "/plans"
+    try:
+        engine, batcher, g = build_serving(cfg)
+        log.write(engine.warmup())
+
+        rng = np.random.default_rng(cfg.seed)
+        failures = []
+
+        # mixed-size closed-loop traffic through the batcher (request sizes
+        # clamped to the graph: a tiny --num_nodes under a tall ladder must
+        # not crash the sampler)
+        expected = engine.full_logits() if cfg.selftest else None
+        max_req = min(engine.ladder.max_size, engine.num_nodes)
+        for _ in range(cfg.requests):
+            n = int(rng.integers(1, max_req + 1))
+            ids = rng.choice(engine.num_nodes, size=n, replace=False)
+            out = batcher.infer(ids)
+            if expected is not None:
+                r, s = engine.rank_slot(ids)
+                ref = expected[r, s]
+                if not np.array_equal(out, ref):
+                    failures.append(
+                        f"served logits diverge from the eval forward "
+                        f"(max abs diff {np.abs(out - ref).max()})"
+                    )
+                    break
+        batcher.stop()
+
+        if cfg.selftest:
+            recompiles = engine.recompiles_since_warmup()
+            if recompiles != 0:
+                failures.append(
+                    f"{recompiles} XLA compiles on the hot path after warmup"
+                )
+            try:
+                engine.infer(np.zeros(engine.ladder.max_size + 1, np.int64))
+                failures.append("over-ladder request was not rejected")
+            except RequestTooLarge as e:
+                log.write(e.record())
+
+        rec = serve_health_record(engine, batcher)
+        if failures:
+            rec["error"] = "; ".join(failures)
+            rec["wedge"] = "stage_failure"
+        log.write(rec)
+        print(json.dumps(rec, default=str))
+        if failures:
+            raise SystemExit("selftest FAILED: " + "; ".join(failures))
+        return rec
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+if __name__ == "__main__":
+    from dgraph_tpu.utils.cli import parse_config
+
+    main(parse_config(Config))

@@ -1,0 +1,238 @@
+"""Micro-batching request queue: bounded depth, deadline, flush policy.
+
+Single-request inference wastes the one resource a TPU serving process has
+plenty of — bucket capacity: a forward over the partitioned graph costs the
+same whether it gathers 3 target rows or 300. :class:`MicroBatcher` closes
+that gap by coalescing concurrent requests into one padded engine call,
+with the three safety properties an online queue needs:
+
+- **bounded depth** — ``submit`` raises :class:`~dgraph_tpu.serve.errors.
+  QueueFull` (a structured rejection) once ``max_queue_depth`` requests
+  wait; overload becomes fast client-visible backpressure instead of
+  unbounded latency.
+- **bounded delay** — a batch flushes when ``max_batch_size`` requests are
+  waiting, when the *oldest* waiting request has aged ``max_delay_ms``, or
+  when the next request would overflow the largest shape bucket.
+- **deadlines** — a request that ages past its timeout while queued is
+  rejected with :class:`~dgraph_tpu.serve.errors.RequestTimeout` and never
+  runs (its client already gave up; spending a batch slot on it only adds
+  latency for live requests). An expired-only batch flushes empty: no
+  engine call at all.
+
+One worker thread owns the engine (device work stays single-threaded, the
+same assumption the training driver makes); clients get a
+``concurrent.futures.Future`` resolving to the logits slice or the
+structured error.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from dgraph_tpu.obs.metrics import Metrics
+from dgraph_tpu.serve.errors import (
+    EngineStopped,
+    QueueFull,
+    RequestTimeout,
+    RequestTooLarge,
+)
+
+
+@dataclasses.dataclass
+class _Pending:
+    ids: np.ndarray
+    future: Future
+    enqueued_at: float  # time.monotonic()
+    deadline: float
+
+
+class MicroBatcher:
+    """Groups concurrent requests into one padded :class:`~dgraph_tpu.serve.
+    engine.ServeEngine` call. See the module docstring for the flush and
+    rejection semantics."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        max_batch_size: int = 8,
+        max_delay_ms: float = 2.0,
+        max_queue_depth: int = 64,
+        default_timeout_s: float = 30.0,
+        registry: Optional[Metrics] = None,
+    ):
+        if max_batch_size < 1 or max_queue_depth < 1:
+            raise ValueError("max_batch_size and max_queue_depth must be >= 1")
+        self.engine = engine
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay_ms = float(max_delay_ms)
+        self.max_queue_depth = int(max_queue_depth)
+        self.default_timeout_s = float(default_timeout_s)
+        self.registry = registry if registry is not None else engine.registry
+        self._q: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._worker = threading.Thread(
+            target=self._loop, name="serve-batcher", daemon=True
+        )
+        self._worker.start()
+        # interpreter exit kills daemon threads WITHOUT joining; a worker
+        # torn down mid-XLA-dispatch aborts the whole process ("terminate
+        # called without an active exception"), so always stop cleanly
+        import atexit
+
+        atexit.register(self.stop)
+
+    def __len__(self) -> int:
+        """Current queue depth (requests waiting, not in flight)."""
+        with self._cv:
+            return len(self._q)
+
+    # --- client side ---
+
+    def submit(self, node_ids, timeout_s: Optional[float] = None) -> Future:
+        """Enqueue one request; returns a Future of the [n, C] logits.
+
+        Raises (never queues past) :class:`QueueFull` at capacity,
+        :class:`RequestTooLarge` for requests no bucket fits, and
+        :class:`EngineStopped` after :meth:`stop`.
+        """
+        ids = np.asarray(node_ids)
+        if ids.ndim != 1:
+            raise ValueError(f"node_ids must be 1-D, got shape {ids.shape}")
+        # full request validation up front: an impossible request must not
+        # occupy a queue slot, and — because the worker CONCATENATES
+        # requests — must never reach the engine, where its failure would
+        # fan out to every innocent request coalesced into the same batch
+        try:
+            self.engine.ladder.bucket_for(ids.shape[0])
+        except RequestTooLarge:
+            self.registry.counter("serve.rejected_too_large")
+            raise
+        num_nodes = getattr(self.engine, "num_nodes", None)
+        if num_nodes is not None and ids.size and (
+            ids.min() < 0 or ids.max() >= num_nodes
+        ):
+            raise ValueError(
+                f"node ids must be in [0, {num_nodes}), got "
+                f"[{ids.min()}, {ids.max()}]"
+            )
+        now = time.monotonic()
+        timeout_s = self.default_timeout_s if timeout_s is None else float(timeout_s)
+        with self._cv:
+            if self._stopped:
+                raise EngineStopped("batcher is stopped")
+            if len(self._q) >= self.max_queue_depth:
+                self.registry.counter("serve.rejected_backpressure")
+                raise QueueFull(
+                    f"queue at capacity ({self.max_queue_depth} requests "
+                    "waiting); retry with backoff",
+                    queue_depth=len(self._q),
+                    max_queue_depth=self.max_queue_depth,
+                )
+            fut: Future = Future()
+            self._q.append(_Pending(ids, fut, now, now + timeout_s))
+            self.registry.gauge("serve.queue_depth", float(len(self._q)))
+            self._cv.notify()
+        return fut
+
+    def infer(self, node_ids, timeout_s: Optional[float] = None) -> np.ndarray:
+        """Blocking submit: logits [n, C], or raises the structured error."""
+        return self.submit(node_ids, timeout_s).result()
+
+    def stop(self, join_timeout_s: float = 10.0) -> None:
+        """Stop the worker (drains whatever is queued, rejecting anything
+        still unserved at join timeout with :class:`EngineStopped`).
+        Idempotent; also runs via atexit if the owner forgot."""
+        import atexit
+
+        atexit.unregister(self.stop)
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._worker.join(timeout=join_timeout_s)
+        with self._cv:
+            while self._q:
+                p = self._q.popleft()
+                if not p.future.done():
+                    p.future.set_exception(EngineStopped("batcher stopped"))
+
+    # --- worker side ---
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            self._flush(batch)
+
+    def _collect(self):
+        """Block until a batch is ready per the flush policy; None = exit."""
+        with self._cv:
+            while not self._q:
+                if self._stopped:
+                    return None
+                self._cv.wait(0.1)
+            # batch window: fill up to max_batch_size or until the OLDEST
+            # request has waited max_delay_ms (per-batch added latency is
+            # bounded by the delay knob, not by arrival luck)
+            flush_at = self._q[0].enqueued_at + self.max_delay_ms / 1e3
+            while len(self._q) < self.max_batch_size and not self._stopped:
+                remaining = flush_at - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            batch, total = [], 0
+            cap = self.engine.ladder.max_size
+            while self._q and len(batch) < self.max_batch_size:
+                nxt = self._q[0]
+                if batch and total + nxt.ids.shape[0] > cap:
+                    break  # would overflow the largest bucket; next batch
+                batch.append(self._q.popleft())
+                total += nxt.ids.shape[0]
+            self.registry.gauge("serve.queue_depth", float(len(self._q)))
+            return batch
+
+    def _flush(self, batch) -> None:
+        now = time.monotonic()
+        live = []
+        for p in batch:
+            if now > p.deadline:
+                self.registry.counter("serve.rejected_timeout")
+                p.future.set_exception(
+                    RequestTimeout(
+                        f"request expired after {now - p.enqueued_at:.3f}s in "
+                        "queue (timeout "
+                        f"{p.deadline - p.enqueued_at:.3f}s)",
+                        waited_s=round(now - p.enqueued_at, 4),
+                    )
+                )
+            else:
+                live.append(p)
+        if not live:
+            return  # expired-only batch: flush empty, no engine call
+        ids = np.concatenate([p.ids for p in live]) if len(live) > 1 else live[0].ids
+        try:
+            out = self.engine.infer(ids)
+        except Exception as e:  # noqa: BLE001 — fan the failure to every waiter
+            for p in live:
+                p.future.set_exception(e)
+            return
+        off = 0
+        done = time.monotonic()
+        for p in live:
+            n = p.ids.shape[0]
+            p.future.set_result(out[off : off + n])
+            off += n
+            self.registry.histogram(
+                "serve.request_ms", (done - p.enqueued_at) * 1e3
+            )
+        self.registry.counter("serve.batches")
+        self.registry.histogram("serve.requests_per_batch", float(len(live)))

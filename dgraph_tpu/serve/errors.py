@@ -1,0 +1,61 @@
+"""Structured serving errors.
+
+Every rejection path in the serving stack raises a typed :class:`ServeError`
+whose :meth:`~ServeError.record` form is a JSONL-able dict — the same
+"structured record over free-text stderr" discipline :mod:`dgraph_tpu.obs.
+health` established for run diagnostics. Callers (and load generators)
+branch on ``.code``, logs get one parseable line per rejection, and nothing
+ever queues unboundedly just because raising felt impolite.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(Exception):
+    """Base serving error; ``record()`` is the structured JSONL form."""
+
+    code = "error"
+
+    def __init__(self, message: str, **context):
+        super().__init__(message)
+        self.context = context
+
+    def record(self) -> dict:
+        return {
+            "kind": "serve_error",
+            "error": self.code,
+            "detail": str(self),
+            **self.context,
+        }
+
+
+class RequestTooLarge(ServeError):
+    """Request exceeds the largest shape bucket. Admitting it would force a
+    fresh XLA compile on the hot path (the one thing the bucket ladder
+    exists to prevent), so it is rejected at submit time; the client should
+    split the request or the operator should raise ``max_bucket``."""
+
+    code = "too_large"
+
+
+class QueueFull(ServeError):
+    """Backpressure: the bounded request queue is at capacity. Rejected
+    immediately so the client can retry/shed load — queue depth, not queue
+    growth, is the knob (an unbounded queue converts overload into
+    unbounded latency for everyone)."""
+
+    code = "backpressure"
+
+
+class RequestTimeout(ServeError):
+    """The request aged past its deadline while waiting in the queue; it is
+    rejected without running (serving stale work wastes a batch slot the
+    client has already given up on)."""
+
+    code = "timeout"
+
+
+class EngineStopped(ServeError):
+    """The batcher/engine was shut down while the request was in flight."""
+
+    code = "stopped"

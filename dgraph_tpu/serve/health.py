@@ -1,0 +1,57 @@
+"""Serve-health record: one JSONL line that makes a serving process
+diagnosable from its artifact alone.
+
+The :class:`~dgraph_tpu.obs.health.RunHealth` discipline (host/env/backend
+snapshot + structured outcome) extended with what an operator asks of a
+*serving* process: the bucket ladder, the warmup cost, the recompile
+counter (the steady-state SLO invariant — must be 0), latency percentiles
+(p50/p95/p99 from the obs registry's histograms), and queue/backpressure
+state. Emitted by ``python -m dgraph_tpu.serve`` on exit and by
+``experiments/serve_bench.py`` alongside its throughput report.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dgraph_tpu.obs.health import RunHealth
+from dgraph_tpu.obs.metrics import Metrics
+
+# the registry histograms surfaced as headline latency numbers, in
+# preference order (end-to-end queue+infer when a batcher ran, bare infer
+# otherwise)
+_LATENCY_HISTOGRAMS = ("serve.request_ms", "serve.infer_ms")
+
+
+def serve_health_record(
+    engine, batcher=None, *, registry: Optional[Metrics] = None
+) -> dict:
+    """One ``kind="serve_health"`` JSONL record for the serving process."""
+    reg = registry if registry is not None else engine.registry
+    h = RunHealth.begin("serve.engine")
+    h.snapshot_backend()
+    snap = reg.snapshot()
+    latency = {"count": 0}
+    for name in _LATENCY_HISTOGRAMS:
+        hist = snap["histograms"].get(name)
+        if hist and hist.get("count"):
+            latency = {"source": name, **hist}
+            break
+    rec = {
+        "kind": "serve_health",
+        **h.finish(),
+        "buckets": [int(b) for b in engine.ladder.sizes],
+        "num_nodes": engine.num_nodes,
+        "warmup_s": engine.warmup_s,
+        "recompiles_since_warmup": engine.recompiles_since_warmup(),
+        "latency_ms": latency,
+        "metrics": snap,
+    }
+    if batcher is not None:
+        rec["queue"] = {
+            "depth": len(batcher),
+            "max_depth": batcher.max_queue_depth,
+            "max_batch_size": batcher.max_batch_size,
+            "max_delay_ms": batcher.max_delay_ms,
+        }
+    return rec

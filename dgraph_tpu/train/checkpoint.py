@@ -16,11 +16,14 @@ comm plans — ``distributed_graph_dataset.py:399-422``,
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import pickle
 from typing import Any, Optional
 
 import numpy as np
+
+_logger = logging.getLogger("dgraph_tpu.checkpoint")
 
 
 def atomic_pickle_dump(path: str, obj: Any) -> None:
@@ -45,28 +48,65 @@ def save_checkpoint(ckpt_dir: str, state: dict, step: int) -> None:
         ckptr.save(path, state, force=True)
 
 
-def latest_step(ckpt_dir: str) -> Optional[int]:
+def all_steps(ckpt_dir: str) -> list:
+    """Ascending list of checkpoint step numbers present in ``ckpt_dir``."""
     if not os.path.isdir(ckpt_dir):
-        return None
-    steps = [
+        return []
+    return sorted(
         int(d.split("_")[1])
         for d in os.listdir(ckpt_dir)
         if d.startswith("step_") and d.split("_")[1].isdigit()
-    ]
-    return max(steps) if steps else None
+    )
 
 
-def restore_checkpoint(ckpt_dir: str, template: dict, step: Optional[int] = None) -> Optional[dict]:
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    steps = all_steps(ckpt_dir)
+    return steps[-1] if steps else None
+
+
+def restore_checkpoint(
+    ckpt_dir: str, template: Optional[dict] = None, step: Optional[int] = None
+) -> Optional[dict]:
     """Restore the given (or latest) step into template's structure; None if
-    no checkpoint exists."""
+    no checkpoint exists. ``template=None`` restores the raw saved tree.
+
+    With ``step=None`` (the serving / resume path), a corrupt/truncated
+    checkpoint (killed mid-save, torn copy) does not abort the restore:
+    the loader logs it and falls back to the next-older step, so the
+    process comes up on the newest *readable* state. Only when every
+    on-disk step fails does the last error propagate (returning None there
+    would silently restart from scratch). An explicitly requested ``step``
+    is strict: missing raises FileNotFoundError, unreadable raises the
+    underlying error — silently serving an older checkpoint than the one
+    NAMED would mislabel every downstream metric.
+    """
     import orbax.checkpoint as ocp
 
-    step = step if step is not None else latest_step(ckpt_dir)
-    if step is None:
+    steps = all_steps(ckpt_dir)
+    if step is not None:
+        if step not in steps:
+            raise FileNotFoundError(
+                f"checkpoint step {step} not found under {ckpt_dir!r} "
+                f"(present: {steps})"
+            )
+        steps = [step]
+    if not steps:
         return None
-    path = os.path.abspath(os.path.join(ckpt_dir, f"step_{step:08d}"))
-    with ocp.PyTreeCheckpointer() as ckptr:
-        return ckptr.restore(path, item=template)
+    last_err = None
+    for s in reversed(steps):
+        path = os.path.abspath(os.path.join(ckpt_dir, f"step_{s:08d}"))
+        try:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                return ckptr.restore(path, item=template)
+        except Exception as e:  # noqa: BLE001 — any read/parse failure
+            if step is not None:
+                raise
+            last_err = e
+            _logger.warning(
+                "checkpoint step_%08d unreadable (%s: %s); falling back to "
+                "next-older step", s, type(e).__name__, e,
+            )
+    raise last_err
 
 
 def checkpoint_keys(ckpt_dir: str, step: Optional[int] = None):
@@ -157,8 +197,14 @@ def cached_edge_plan(
     )
     path = os.path.join(cache_dir, f"plan_{key}.pkl")
     if os.path.exists(path):
-        with open(path, "rb") as f:
-            return pickle.load(f)
+        try:
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        except Exception as e:  # noqa: BLE001 — truncated/corrupt pickle
+            _logger.warning(
+                "plan cache %s unreadable (%s: %s); rebuilding",
+                path, type(e).__name__, e,
+            )
     result = build_edge_plan(edge_index, src_partition, dst_partition, **build_kwargs)
     atomic_pickle_dump(path, result)
     return result

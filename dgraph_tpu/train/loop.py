@@ -99,6 +99,17 @@ def vmask_batch_args(b: dict, plan):
     return [b["x"], plan, b["vmask"]]
 
 
+def model_apply(model, params, b: dict, plan, batch_args: Callable = None):
+    """THE per-shard forward call: train, eval, and serve all route the
+    model through this one helper (``model.apply(params, *batch_args(b,
+    plan))``), so the forward semantics — which batch keys feed which model
+    arguments — cannot drift between the three paths. ``b`` and ``plan``
+    are per-shard (already squeezed); ``batch_args`` defaults to the
+    GCN-family ``(x, plan, [edge_weight])`` builder."""
+    batch_args = batch_args or _batch_args
+    return model.apply(params, *batch_args(b, plan))
+
+
 def make_train_step(
     model,
     optimizer: optax.GradientTransformation,
@@ -156,7 +167,7 @@ def make_train_step(
         b = _squeeze_batch(batch)
 
         def lf(p):
-            logits = model.apply(p, *batch_args(b, plan))
+            logits = model_apply(model, p, b, plan, batch_args)
             loss = loss_fn(logits, b["y"], b["mask"], GRAPH_AXIS)
             if b["y"].ndim == logits.ndim:
                 # multi-label float targets: per-label binary accuracy
@@ -227,7 +238,7 @@ def make_eval_step(model, mesh, loss_fn: Callable = masked_cross_entropy,
     def shard_body(params, batch, plan):
         plan = squeeze_plan(plan)
         b = jax.tree.map(lambda leaf: leaf[0], batch)
-        logits = model.apply(params, *batch_args(b, plan))
+        logits = model_apply(model, params, b, plan, batch_args)
         loss = loss_fn(logits, b["y"], b["mask"], GRAPH_AXIS)
         if b["y"].ndim == logits.ndim:
             hits = ((logits > 0) == (b["y"] > 0.5)).mean(axis=-1)

@@ -1,0 +1,158 @@
+"""Closed-loop serving load generator: throughput/latency for the bench
+JSON trajectory.
+
+Spins up the full serving stack (``dgraph_tpu.serve``: warmed engine +
+micro-batcher) over a synthetic graph, then drives it with N closed-loop
+client threads (each submits a uniformly-sized random request, waits for
+the result, repeats). Reports one ``kind="serve_bench"`` JSONL record:
+throughput (requests and target-nodes per second), latency percentiles
+(p50/p95/p99 end-to-end through the queue), batch occupancy, rejection
+counts, and the recompile counter (must be 0 — a nonzero value means the
+bucket ladder leaked a shape and latency numbers are compile noise).
+
+Run (single host; CPU works — the point is trajectory, not absolute ms):
+    JAX_PLATFORMS=cpu python experiments/serve_bench.py --clients 4
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+
+
+@dataclasses.dataclass
+class Config:
+    """Closed-loop load generation against the serving stack."""
+
+    # serving stack (forwarded to dgraph_tpu.serve.__main__.build_serving)
+    num_nodes: int = 5000
+    num_classes: int = 8
+    feat_dim: int = 32
+    avg_degree: float = 8.0
+    model: str = "gcn"
+    hidden: int = 32
+    num_layers: int = 2
+    world_size: int = 0
+    partition: str = "random"
+    min_bucket: int = 8
+    max_bucket: int = 256
+    growth: float = 2.0
+    max_batch_size: int = 8
+    max_delay_ms: float = 2.0
+    max_queue_depth: int = 128
+    request_timeout_s: float = 60.0
+    # load
+    clients: int = 4
+    requests_per_client: int = 50
+    min_request: int = 1
+    max_request: int = 128
+    seed: int = 0
+    log_path: str = "logs/serve_bench.jsonl"
+
+
+def main(cfg: Config) -> dict:
+    import numpy as np
+
+    from dgraph_tpu.obs.health import startup_record
+    from dgraph_tpu.serve.__main__ import Config as ServeConfig, build_serving
+    from dgraph_tpu.serve.errors import ServeError
+    from dgraph_tpu.serve.health import serve_health_record
+    from dgraph_tpu.utils import ExperimentLog
+
+    if cfg.max_request > cfg.max_bucket:
+        raise SystemExit(
+            f"max_request {cfg.max_request} exceeds max_bucket {cfg.max_bucket}"
+        )
+    log = ExperimentLog(cfg.log_path, echo=False)
+    log.write(startup_record("experiments.serve_bench"))
+
+    serve_cfg = ServeConfig(
+        num_nodes=cfg.num_nodes,
+        num_classes=cfg.num_classes,
+        feat_dim=cfg.feat_dim,
+        avg_degree=cfg.avg_degree,
+        partition=cfg.partition,
+        world_size=cfg.world_size,
+        model=cfg.model,
+        hidden=cfg.hidden,
+        num_layers=cfg.num_layers,
+        seed=cfg.seed,
+        min_bucket=cfg.min_bucket,
+        max_bucket=cfg.max_bucket,
+        growth=cfg.growth,
+        max_batch_size=cfg.max_batch_size,
+        max_delay_ms=cfg.max_delay_ms,
+        max_queue_depth=cfg.max_queue_depth,
+        request_timeout_s=cfg.request_timeout_s,
+    )
+    engine, batcher, _g = build_serving(serve_cfg)
+    log.write(engine.warmup())
+
+    ok = [0] * cfg.clients
+    rejected = [0] * cfg.clients
+    nodes_served = [0] * cfg.clients
+
+    def client(i: int) -> None:
+        rng = np.random.default_rng(cfg.seed * 1000 + i)
+        for _ in range(cfg.requests_per_client):
+            n = int(rng.integers(cfg.min_request, cfg.max_request + 1))
+            ids = rng.integers(0, engine.num_nodes, n)
+            try:
+                batcher.infer(ids)
+                ok[i] += 1
+                nodes_served[i] += n
+            except ServeError as e:
+                rejected[i] += 1
+                log.write(e.record())
+
+    threads = [
+        threading.Thread(target=client, args=(i,), name=f"client-{i}")
+        for i in range(cfg.clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    batcher.stop()
+
+    snap = engine.registry.snapshot()
+    lat = snap["histograms"].get("serve.request_ms", {"count": 0})
+    occ = snap["histograms"].get("serve.batch_occupancy", {})
+    completed = sum(ok)
+    report = {
+        "kind": "serve_bench",
+        # headline for the bench trajectory: completed requests per second
+        "value": round(completed / wall_s, 2) if wall_s > 0 else None,
+        "throughput_rps": round(completed / wall_s, 2) if wall_s > 0 else None,
+        "throughput_nodes_per_s": (
+            round(sum(nodes_served) / wall_s, 1) if wall_s > 0 else None
+        ),
+        "wall_s": round(wall_s, 3),
+        "clients": cfg.clients,
+        "completed": completed,
+        "rejected": sum(rejected),
+        "latency_ms": {
+            k: lat.get(k) for k in ("count", "mean", "p50", "p95", "p99", "max")
+        },
+        "batch_occupancy_mean": occ.get("mean"),
+        "recompiles_since_warmup": engine.recompiles_since_warmup(),
+        "buckets": [int(b) for b in engine.ladder.sizes],
+        "config": dataclasses.asdict(cfg),
+    }
+    log.write(report)
+    log.write(serve_health_record(engine, batcher))
+    print(json.dumps(report))
+    return report
+
+
+if __name__ == "__main__":
+    import os as _os, sys as _sys
+
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+    from dgraph_tpu.utils.cli import parse_config
+
+    main(parse_config(Config))

@@ -18,6 +18,13 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compilation cache: the suite is compile-dominated (every
+# shard_map train step traces to a fresh executable), and jax's
+# content-addressed cache (keyed on HLO + compile options + backend) makes
+# repeat runs in one container reuse yesterday's binaries. Set via env var
+# so subprocess tests (CLI smokes, multi-process launches) inherit it.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dgraph_tpu_xla_cache")
+
 # The baked axon sitecustomize imports jax at interpreter startup (before this
 # conftest), freezing jax_platforms='axon' from the ambient env. Backend
 # initialization is lazy, so overriding the config here (before any jax API
@@ -25,6 +32,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+try:  # compilation cache knobs (names are stable across 0.4-0.6)
+    jax.config.update(
+        "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:  # unknown option on some jax: run uncached, never break
+    pass
 
 # jax-version shims (jax.shard_map / jax.set_mesh on 0.4.x) must be in
 # place before test modules that use the modern spellings are imported.

@@ -325,6 +325,75 @@ def test_step_record_schema_roundtrip():
         StepMetrics.from_record({"kind": "run_health"})
 
 
+def test_histogram_quantiles_match_numpy():
+    """The registry's quantile math (linear interpolation between order
+    statistics) must agree with np.percentile's default method, so live
+    snapshots and offline JSONL analysis publish the same numbers."""
+    rng = np.random.default_rng(7)
+    vals = rng.lognormal(3.0, 1.0, size=257)
+    m = Metrics()
+    for v in vals:
+        m.histogram("lat_ms", v)
+    for q in (0.0, 0.25, 0.5, 0.9, 0.95, 0.99, 1.0):
+        assert m.quantile("lat_ms", q) == pytest.approx(
+            float(np.percentile(vals, q * 100)), rel=1e-12
+        )
+    snap = m.snapshot()["histograms"]["lat_ms"]
+    assert snap["p50"] == pytest.approx(float(np.percentile(vals, 50)))
+    assert snap["p95"] == pytest.approx(float(np.percentile(vals, 95)))
+    assert snap["p99"] == pytest.approx(float(np.percentile(vals, 99)))
+    json.dumps(snap)
+
+
+def test_histogram_quantile_edge_cases():
+    m = Metrics()
+    m.histogram("one", 42.0)
+    # single observation: every quantile is that value
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert m.quantile("one", q) == 42.0
+    # two observations: exact midpoint interpolation
+    m.histogram("two", 10.0)
+    m.histogram("two", 20.0)
+    assert m.quantile("two", 0.5) == 15.0
+    assert m.quantile("two", 0.25) == 12.5
+    # insertion order must not matter (quantile sorts)
+    m.histogram("rev", 5.0)
+    m.histogram("rev", 1.0)
+    m.histogram("rev", 3.0)
+    assert m.quantile("rev", 0.5) == 3.0
+    # errors: out-of-range q, never-observed name, empty histogram
+    with pytest.raises(ValueError):
+        m.quantile("one", 1.5)
+    with pytest.raises(ValueError):
+        m.quantile("one", -0.1)
+    with pytest.raises(KeyError):
+        m.quantile("never", 0.5)
+    from dgraph_tpu.obs.metrics import _Histogram
+
+    with pytest.raises(ValueError):
+        _Histogram().quantile(0.5)
+    assert _Histogram().snapshot() == {"count": 0}
+
+
+def test_histogram_memory_bounded_reservoir():
+    """Past MAX_SAMPLES observations the histogram must stop growing
+    (serving records several per request, forever); count/mean/min/max stay
+    exact and reservoir quantiles stay close on a uniform stream."""
+    from dgraph_tpu.obs.metrics import _Histogram
+
+    h = _Histogram()
+    n = h.MAX_SAMPLES * 4
+    for i in range(n):
+        h.observe(float(i))
+    assert len(h.values) == h.MAX_SAMPLES
+    snap = h.snapshot()
+    assert snap["count"] == n
+    assert snap["min"] == 0.0 and snap["max"] == float(n - 1)
+    assert snap["mean"] == pytest.approx((n - 1) / 2)
+    # uniform stream: reservoir p50 within a few percent of the true median
+    assert snap["p50"] == pytest.approx((n - 1) / 2, rel=0.05)
+
+
 def test_metrics_registry():
     m = Metrics()
     m.counter("plans_built")
