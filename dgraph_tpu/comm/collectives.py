@@ -47,7 +47,12 @@ def _use_ppermute(axis_name, deltas) -> bool:
 
     if axis_name is None or deltas is None:
         return False
+    # same precedence as plan.resolve_halo_impl (env pin > adopted tuning
+    # record > heuristic) — checked inline because the heuristic tier needs
+    # the axis size, which only exists inside the traced context here
     impl = _cfg.halo_impl
+    if impl not in ("ppermute", "all_to_all"):
+        impl = _cfg.tuned_halo_impl
     if impl == "ppermute":
         return True
     if impl == "all_to_all":

@@ -121,8 +121,22 @@ gather_col_block: int = int(os.environ.get("DGRAPH_TPU_GATHER_COL_BLOCK", "128")
 
 # Halo exchange lowering: 'auto' (ppermute neighbor rounds when the plan's
 # active peer-delta set is sparse, else one padded all_to_all),
-# 'all_to_all', or 'ppermute'.
+# 'all_to_all', or 'ppermute'. Resolution precedence lives in
+# plan.resolve_halo_impl: this env pin > the adopted tuning record
+# (tuned_halo_impl below) > the cost-model heuristic.
 halo_impl: str = os.environ.get("DGRAPH_TPU_HALO_IMPL", "auto")
+
+# Halo lowering chosen by an adopted TuningRecord (dgraph_tpu.tune):
+# set by tune.record.adopt_record, consulted by plan.resolve_halo_impl
+# AFTER the env pin — an operator's explicit DGRAPH_TPU_HALO_IMPL always
+# beats a persisted search result. None = no record adopted.
+tuned_halo_impl: str | None = None
+
+# record_id of the MOST RECENTLY adopted TuningRecord (None = defaults in
+# effect). Set by tune.record.adopt_record, reset by clear_adoption on a
+# lookup miss; process-level attribution for consumers without a graph
+# handle (artifact writers read the id off their graph/engine directly).
+tuning_record_id: str | None = None
 
 
 def set_flags(**kw) -> None:

@@ -41,6 +41,14 @@ class DistributedGraph:
     masks: dict  # split name -> [W, n_pad] f32
     vertex_mask: np.ndarray  # [W, n_pad] f32: 1.0 for real vertices
     edge_weight: Optional[np.ndarray] = None  # [W, e_pad] f32
+    # the adopted TuningRecord (dgraph_tpu.tune), or None when the
+    # hard-coded defaults are in effect; serving/health artifacts read
+    # tuning_record_id off this so perf numbers stay attributable
+    tuning_record: Optional[object] = None
+
+    @property
+    def tuning_record_id(self) -> Optional[str]:
+        return self.tuning_record.record_id if self.tuning_record else None
 
     @classmethod
     def from_global(
@@ -51,16 +59,67 @@ class DistributedGraph:
         masks: Optional[dict],
         world_size: int,
         *,
-        partition_method: str = "rcm",
+        partition_method: Optional[str] = None,
         edge_owner: str = "dst",
         add_symmetric_norm: bool = False,
-        pad_multiple: int = 8,
+        pad_multiple: Optional[int] = None,
         seed: int = 0,
         partition_kwargs: Optional[dict] = None,
         plan_cache_dir: str = "",
+        tune: str = "auto",
     ) -> "DistributedGraph":
+        """Partition + plan + shard one global graph.
+
+        ``partition_method`` / ``pad_multiple`` left at None resolve
+        through the tuning layer: with ``tune="auto"`` (default) a
+        persisted :class:`~dgraph_tpu.tune.record.TuningRecord` matching
+        this graph's signature (in ``plan_cache_dir`` or the default
+        record dir; env ``DGRAPH_TUNE_RECORD`` pins/disables) supplies
+        them, else the hard-coded defaults (``"rcm"`` / ``8``) apply.
+        Explicit values always win — adoption never overrides a caller's
+        stated choice. ``tune="off"`` skips the lookup entirely.
+        """
+        if tune not in ("auto", "off"):
+            raise ValueError(f"tune must be 'auto' or 'off', got {tune!r}")
         num_nodes = features.shape[0]
         edge_index = np.asarray(edge_index)
+        from dgraph_tpu.tune.record import (
+            adopt_record,
+            clear_adoption,
+            lookup_record,
+        )
+
+        record = None
+        if tune == "auto" and (partition_method is None or pad_multiple is None):
+            from dgraph_tpu import config as _cfg
+            from dgraph_tpu.tune.signature import graph_signature
+
+            # dtype axis of the signature = the COMPUTE dtype the run will
+            # use (a bfloat16-tuned record is a different workload from a
+            # float32 one), not the storage dtype of the features array —
+            # from_global casts those to f32 regardless
+            sig = graph_signature(
+                edge_index, num_nodes, world_size,
+                dtype=_cfg.default_compute_dtype,
+                feat_dim=features.shape[1] if features.ndim > 1 else 0,
+            )
+            record = lookup_record(sig, cache_dir=plan_cache_dir)
+            if record is not None:
+                tuned = adopt_record(record)
+                if partition_method is None:
+                    partition_method = tuned.get("partition_method")
+                if pad_multiple is None:
+                    pad_multiple = tuned.get("pad_multiple")
+        if record is None:
+            # no record adopted for THIS graph — whether the lookup missed,
+            # tune="off", or explicit knobs skipped it entirely: reset the
+            # process-global tuned flags so an earlier graph's adopted halo
+            # lowering cannot leak onto this one (most-recent-wins)
+            clear_adoption()
+        if partition_method is None:
+            partition_method = "rcm"
+        if pad_multiple is None:
+            pad_multiple = 8
         new_edges, ren = pt.partition_graph(
             edge_index, num_nodes, world_size, method=partition_method,
             seed=seed, **(partition_kwargs or {}),
@@ -120,6 +179,7 @@ class DistributedGraph:
             masks=m,
             vertex_mask=vmask,
             edge_weight=ew,
+            tuning_record=record,
         )
 
     def batch(self, split: str) -> dict:

@@ -12,6 +12,34 @@ from __future__ import annotations
 
 import numpy as np
 
+# ogbn-arxiv shape (V, directed E before symmetrization) — the bench
+# workload's dimensions
+ARXIV_NODES = 169_343
+ARXIV_EDGES = 1_166_243
+
+
+def random_edges(
+    num_nodes: int, num_edges: int, seed: int = 0, symmetrize: bool = True
+) -> np.ndarray:
+    """Uniform random [2, E] edge list — THE shared construction
+    ``bench.py``, ``obs.footprint``'s CLI, and ``dgraph_tpu.tune`` use for
+    the arxiv-shaped synthetic workload. One definition, because the tune
+    subsystem keys records on a graph signature: three hand-rolled copies
+    that drift by an rng call would silently stop matching each other."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, num_nodes, num_edges)
+    dst = rng.integers(0, num_nodes, num_edges)
+    if symmetrize:
+        return np.stack(
+            [np.concatenate([src, dst]), np.concatenate([dst, src])]
+        ).astype(np.int64)
+    return np.stack([src, dst]).astype(np.int64)
+
+
+def arxiv_shaped_edges(seed: int = 0) -> tuple:
+    """(edge_index [2, 2*ARXIV_EDGES], num_nodes) for the bench workload."""
+    return random_edges(ARXIV_NODES, ARXIV_EDGES, seed), ARXIV_NODES
+
 
 def sbm_classification_graph(
     num_nodes: int = 1000,

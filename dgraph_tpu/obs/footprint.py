@@ -87,7 +87,7 @@ def plan_footprint(
       param_count: when > 0, also accounts the per-step gradient-sync psum
         (ring all-reduce volume) at f32.
     """
-    from dgraph_tpu.plan import pick_halo_impl
+    from dgraph_tpu.plan import resolve_halo_impl
 
     W, S = plan.world_size, plan.halo.s_pad
     b = dtype_bytes(dtype)
@@ -101,14 +101,9 @@ def plan_footprint(
     real_rows = int(real_counts.sum())
     n_deltas = len(plan.halo_deltas)
     # mirror the runtime's lowering choice (comm/collectives._use_ppermute):
-    # a DGRAPH_TPU_HALO_IMPL pin overrides the cost model, and the report
-    # must account the lowering the run actually executes
-    from dgraph_tpu import config as _cfg
-
-    if _cfg.halo_impl in ("all_to_all", "ppermute") and plan.halo_deltas:
-        impl = _cfg.halo_impl
-    else:
-        impl = pick_halo_impl(W, plan.halo_deltas)
+    # env pin > adopted tuning record > heuristic — the report must account
+    # the lowering the run actually executes, whoever chose it
+    impl, impl_source = resolve_halo_impl(W, plan.halo_deltas)
 
     # one halo_exchange (the gather's comm leg); halo_scatter_sum (the
     # scatter's reverse leg / the exchange's transpose) moves the same.
@@ -138,6 +133,7 @@ def plan_footprint(
     operand_by_impl = {"all_to_all": a2a_operand, "ppermute": pp_operand}
     exchange = {
         "impl": impl,
+        "impl_source": impl_source,
         "operand_bytes_per_shard": operand_by_impl.get(impl, 0),
         "a2a_operand_bytes_per_shard": a2a_operand,
         "ici_bytes_per_shard": chosen_wire,
@@ -237,17 +233,11 @@ def main(cfg: Config) -> dict:
     from dgraph_tpu import partition as pt
     from dgraph_tpu.plan import build_edge_plan
 
+    from dgraph_tpu.data.synthetic import ARXIV_EDGES, ARXIV_NODES, random_edges
+
     if cfg.arxiv:
-        cfg.nodes, cfg.edges = 169_343, 1_166_243
-    rng = np.random.default_rng(cfg.seed)
-    src = rng.integers(0, cfg.nodes, cfg.edges)
-    dst = rng.integers(0, cfg.nodes, cfg.edges)
-    if cfg.symmetrize:
-        edge_index = np.stack(
-            [np.concatenate([src, dst]), np.concatenate([dst, src])]
-        ).astype(np.int64)
-    else:
-        edge_index = np.stack([src, dst]).astype(np.int64)
+        cfg.nodes, cfg.edges = ARXIV_NODES, ARXIV_EDGES
+    edge_index = random_edges(cfg.nodes, cfg.edges, cfg.seed, cfg.symmetrize)
     new_edges, ren = pt.partition_graph(
         edge_index, cfg.nodes, cfg.world, method=cfg.partition, seed=cfg.seed
     )

@@ -403,7 +403,7 @@ def plan_memory_usage(plan: EdgePlan, feature_dim: int, dtype_bytes: int = 4) ->
 
 
 def pick_halo_impl(world_size: int, halo_deltas: tuple) -> str:
-    """Choose the halo-exchange lowering from the plan's active peer set.
+    """The heuristic halo-exchange lowering from the plan's active peer set.
 
     Cost model: one padded ``all_to_all`` moves ``(W-1) * s_pad`` remote rows
     per shard no matter how many peer pairs are actually live; ``ppermute``
@@ -412,10 +412,43 @@ def pick_halo_impl(world_size: int, halo_deltas: tuple) -> str:
     partitions on mesh-like graphs — SURVEY §7 "ppermute rounds only to
     actual neighbors"); the crossover is ~W/2 live deltas.
     Returns 'none' | 'ppermute' | 'all_to_all'.
+
+    This is the FALLBACK tier only: runtime call sites resolve through
+    :func:`resolve_halo_impl`, which lets an env pin or an adopted tuning
+    record override the heuristic.
     """
     if not halo_deltas:
         return "none"
     return "ppermute" if len(halo_deltas) <= max(1, world_size // 2) else "all_to_all"
+
+
+def resolve_halo_impl(world_size: int, halo_deltas: tuple) -> tuple[str, str]:
+    """The halo lowering the run will actually execute, plus who decided.
+
+    Returns ``(impl, source)`` with source one of:
+
+    - ``'env'``       — ``DGRAPH_TPU_HALO_IMPL`` (or ``config.set_flags``)
+      pins the lowering; the operator's word is final.
+    - ``'record'``    — an adopted :class:`~dgraph_tpu.tune.record.
+      TuningRecord` chose it (``config.tuned_halo_impl``).
+    - ``'heuristic'`` — :func:`pick_halo_impl`'s cost model.
+    - ``'plan'``      — the plan has no cross-rank traffic at all; there is
+      nothing to choose (impl is ``'none'``).
+
+    Every consumer of the decision (``comm.collectives``'s runtime dispatch,
+    ``obs.footprint``'s byte accounting, :func:`plan_efficiency`'s report)
+    resolves through here, so what runs, what is accounted, and what is
+    reported can never be three different lowerings.
+    """
+    from dgraph_tpu import config as _cfg
+
+    if not halo_deltas:
+        return "none", "plan"
+    if _cfg.halo_impl in ("all_to_all", "ppermute"):
+        return _cfg.halo_impl, "env"
+    if _cfg.tuned_halo_impl in ("all_to_all", "ppermute"):
+        return _cfg.tuned_halo_impl, "record"
+    return pick_halo_impl(world_size, halo_deltas), "heuristic"
 
 
 def plan_efficiency(plan: EdgePlan, layout: EdgePlanLayout) -> dict:
@@ -434,6 +467,7 @@ def plan_efficiency(plan: EdgePlan, layout: EdgePlanLayout) -> dict:
     n_deltas = len(plan.halo_deltas)
     src_total = int(layout.src_counts.sum())
     dst_total = int(layout.dst_counts.sum())
+    impl, impl_source = resolve_halo_impl(W, plan.halo_deltas)
     return {
         "edge_fill": real_edges / max(W * E, 1),
         "src_vertex_fill": src_total / max(W * plan.n_src_pad, 1),
@@ -447,7 +481,10 @@ def plan_efficiency(plan: EdgePlan, layout: EdgePlanLayout) -> dict:
         "halo_wire_fill_ppermute": real_halo / max(n_deltas * W * S, 1) if n_deltas else 1.0,
         "active_peer_pairs": active_pairs,
         "num_halo_deltas": n_deltas,
-        "halo_impl": pick_halo_impl(W, plan.halo_deltas),
+        "halo_impl": impl,
+        # who decided the lowering: 'env' pin, adopted tuning 'record',
+        # cost-model 'heuristic', or 'plan' (no traffic to lower)
+        "halo_impl_source": impl_source,
     }
 
 
@@ -509,6 +546,11 @@ def validate_plan(plan: EdgePlan) -> None:
                 break
     if errors:
         raise ValueError("invalid EdgePlan: " + "; ".join(errors))
+    impl, impl_source = resolve_halo_impl(W, plan.halo_deltas)
+    _logger.info(
+        "validate_plan OK: W=%d e_pad=%d s_pad=%d; halo lowering=%s "
+        "(decided by %s)", W, plan.e_pad, S, impl, impl_source,
+    )
 
 
 @dataclasses.dataclass
@@ -551,6 +593,51 @@ def _pad_to(x: int, multiple: int) -> int:
     if multiple <= 1:
         return max(x, 1)
     return max(-(-x // multiple) * multiple, multiple)
+
+
+def _reject_incompatible_knobs(
+    pad_multiple: int, e_pad: Optional[int], s_pad: Optional[int]
+) -> None:
+    """Fail fast on tunable combinations that cannot lower cleanly, naming
+    the conflicting knobs — the autotuner (and any caller sweeping plan
+    geometry) must get a structured rejection here, not a shape error deep
+    in ``_finalize_plan`` or a silent per-step re-pad inside the Pallas
+    kernels. Raises ValueError."""
+    if pad_multiple < 1:
+        raise ValueError(f"pad_multiple={pad_multiple} must be >= 1")
+    if e_pad is not None:
+        if e_pad < 1:
+            raise ValueError(f"e_pad={e_pad} must be >= 1")
+        if pad_multiple > 1 and e_pad % pad_multiple:
+            raise ValueError(
+                f"e_pad={e_pad} conflicts with pad_multiple={pad_multiple}: "
+                f"an explicit e_pad must be a multiple of pad_multiple "
+                f"(lane tiling); pick e_pad={_pad_to(e_pad, pad_multiple)} "
+                f"or drop one of the two knobs"
+            )
+        if e_pad >= SCATTER_BLOCK_E and e_pad % SCATTER_BLOCK_E:
+            # kernel-scale plans must align to the scatter block: a
+            # non-multiple makes every pallas_call re-pad its [E, F]
+            # operand — a full HBM copy per kernel per step (the r4c
+            # finding _edge_pad_align exists to prevent). Sub-block plans
+            # (e_pad < SCATTER_BLOCK_E) are exempt: the in-op pad there is
+            # negligible and hand-analyzed test plans pin exact tiny shapes.
+            raise ValueError(
+                f"e_pad={e_pad} conflicts with scatter_block_e="
+                f"{SCATTER_BLOCK_E}: a kernel-scale e_pad must be a "
+                f"multiple of the Pallas scatter block (or stay below it); "
+                f"pick e_pad={_pad_to(e_pad, SCATTER_BLOCK_E)} or set "
+                f"DGRAPH_TPU_SCATTER_BLOCK_E to a divisor of e_pad"
+            )
+    if s_pad is not None:
+        if s_pad < 1:
+            raise ValueError(f"s_pad={s_pad} must be >= 1")
+        if pad_multiple > 1 and s_pad % pad_multiple:
+            raise ValueError(
+                f"s_pad={s_pad} conflicts with pad_multiple={pad_multiple}: "
+                f"an explicit s_pad must be a multiple of pad_multiple; "
+                f"pick s_pad={_pad_to(s_pad, pad_multiple)}"
+            )
 
 
 def _edge_pad_align(e_max: int, pad_multiple: int) -> int:
@@ -604,6 +691,7 @@ def build_edge_plan(
     edge_index = np.asarray(edge_index)
     if edge_index.ndim != 2 or edge_index.shape[0] != 2:
         raise ValueError(f"edge_index must be [2, E], got {edge_index.shape}")
+    _reject_incompatible_knobs(pad_multiple, e_pad, s_pad)
     src_partition = np.asarray(src_partition)
     homogeneous = dst_partition is None
     dst_partition = src_partition if homogeneous else np.asarray(dst_partition)

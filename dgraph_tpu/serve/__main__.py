@@ -48,10 +48,12 @@ class Config:
     # tempdir for both so the restore + cache paths are always exercised)
     ckpt_dir: str = ""
     plan_cache: str = ""
-    # bucket ladder
+    # bucket ladder; use_tuned_ladder lets an adopted TuningRecord's
+    # serve geometry (dgraph_tpu.tune) override these three flags
     min_bucket: int = 8
     max_bucket: int = 64
     growth: float = 2.0
+    use_tuned_ladder: bool = True
     # micro-batcher
     max_batch_size: int = 8
     max_delay_ms: float = 2.0
@@ -133,7 +135,16 @@ def build_serving(cfg: Config):
     params = init_params(model, mesh, plan, batch, seed=cfg.seed)
 
     registry = Metrics()
-    ladder = BucketLadder.geometric(cfg.min_bucket, cfg.max_bucket, cfg.growth)
+    min_b, max_b, growth = cfg.min_bucket, cfg.max_bucket, cfg.growth
+    rec = g.tuning_record
+    if cfg.use_tuned_ladder and rec is not None and rec.config.get("serve"):
+        s = rec.config["serve"]
+        min_b, max_b, growth = s["min_bucket"], s["max_bucket"], s["growth"]
+        print(
+            f"bucket ladder from tuning record {rec.record_id}: "
+            f"min={min_b} max={max_b} growth={growth}"
+        )
+    ladder = BucketLadder.geometric(min_b, max_b, growth)
     if cfg.ckpt_dir:
         # serving restores from disk, never from in-process state. An EMPTY
         # dir is seeded with the just-initialized params so the save ->

@@ -60,10 +60,15 @@ class ServeEngine:
         ladder: Optional[BucketLadder] = None,
         batch_args: Optional[Callable] = None,
         registry: Optional[Metrics] = None,
+        tuning_record_id: Optional[str] = None,
     ):
         self.model = model
         self.mesh = mesh
         self.ladder = ladder or BucketLadder.geometric()
+        # provenance only (the ladder/plan themselves arrive already
+        # built): stamped into serve_health so latency artifacts are
+        # attributable to the tuning config that produced them
+        self.tuning_record_id = tuning_record_id
         self.batch_args = batch_args
         self.registry = registry if registry is not None else default_registry
         self._plan = jax.tree.map(jnp.asarray, plan)
@@ -102,6 +107,9 @@ class ServeEngine:
         batch = {"x": g.features, "vmask": g.vertex_mask}
         if g.edge_weight is not None:
             batch["edge_weight"] = g.edge_weight
+        kwargs.setdefault(
+            "tuning_record_id", getattr(g, "tuning_record_id", None)
+        )
         return cls(model, mesh, g.plan, params, batch, rank, slot, **kwargs)
 
     @classmethod

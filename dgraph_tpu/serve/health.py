@@ -44,6 +44,9 @@ def serve_health_record(
         "num_nodes": engine.num_nodes,
         "warmup_s": engine.warmup_s,
         "recompiles_since_warmup": engine.recompiles_since_warmup(),
+        # the adopted tuning record (dgraph_tpu.tune) these latency numbers
+        # were produced under, or None for the hard-coded defaults
+        "tuning_record": getattr(engine, "tuning_record_id", None),
         "latency_ms": latency,
         "metrics": snap,
     }

@@ -1,0 +1,47 @@
+"""Plan autotuner: cost-model-guided configuration search.
+
+The reference picks its communication strategy per graph by hand (three
+hand-chosen backends, ``DGraph/Communicator.py:21``); this subsystem
+replaces that class of decision with a search over the configuration space
+the framework already exposes:
+
+- partition method (:func:`dgraph_tpu.partition.partition_graph`),
+- edge-plan layout / ``pad_multiple`` (:func:`dgraph_tpu.plan.build_edge_plan`),
+- halo lowering (:func:`dgraph_tpu.plan.pick_halo_impl` candidates),
+- Pallas-vs-XLA scatter (from the on-chip sweep log, when present),
+- serve :class:`~dgraph_tpu.serve.bucketing.BucketLadder` geometry.
+
+Two phases: a cheap **analytic** phase ranks every candidate by
+:func:`dgraph_tpu.obs.footprint.plan_footprint`'s byte/imbalance/roofline
+model (never touches a device), then an optional **measured** phase times
+only the top-K survivors with the compile-inside-scan protocol ``bench.py``
+uses. The winner persists as a versioned :class:`~dgraph_tpu.tune.record.
+TuningRecord` (JSON, keyed by a renumbering-invariant graph signature) in
+the plan-cache directory, and is auto-adopted by
+``DistributedGraph.from_global``, ``ServeEngine``, and ``bench.py`` when
+the signature matches (env ``DGRAPH_TUNE_RECORD`` pins or disables).
+
+CLI::
+
+    python -m dgraph_tpu.tune --budget 0        # analytic-only, arxiv shape
+    python -m dgraph_tpu.tune --selftest true   # tier-1 smoke
+"""
+
+from dgraph_tpu.tune.record import (
+    TuningRecord,
+    adopt_record,
+    default_record_dir,
+    lookup_record,
+)
+from dgraph_tpu.tune.search import search
+from dgraph_tpu.tune.signature import graph_signature, signature_key
+
+__all__ = [
+    "TuningRecord",
+    "adopt_record",
+    "default_record_dir",
+    "lookup_record",
+    "search",
+    "graph_signature",
+    "signature_key",
+]

@@ -1,0 +1,99 @@
+"""Graph signatures: the key a :class:`~dgraph_tpu.tune.record.TuningRecord`
+is filed under.
+
+A tuning decision transfers between runs only when the *workload* matches,
+not the literal arrays: the same graph re-loaded with a different vertex
+numbering (or rebuilt from an edge list in a different order) must map to
+the same record, while a graph with a different size, skew, topology width,
+or activation dtype must not. The signature therefore hashes
+renumbering-invariant aggregates only:
+
+- vertex / edge counts,
+- a log2-bucketed total-degree histogram digest (captures the power-law
+  skew that decides ``s_pad`` inflation and shard imbalance — the quantity
+  :func:`~dgraph_tpu.plan.plan_efficiency` measures after the fact),
+- world size (the plan's padding geometry is per-topology),
+- activation dtype and feature width (the roofline's byte axis).
+
+Everything is pure host numpy; hashing a papers100M-scale edge list is two
+bincounts, not a sort.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import numpy as np
+
+SIGNATURE_SCHEMA_VERSION = 1
+
+# log2 degree buckets: bucket 0 = degree 0, bucket b>=1 = degree in
+# [2^(b-1), 2^b). 40 buckets cover degrees past 5e11 — every real graph.
+DEGREE_BUCKETS = 40
+
+
+def canonical_dtype(dtype) -> str:
+    """'bfloat16' / 'float32' / ... for numpy dtypes, jax dtypes, and
+    plain strings (the same family :func:`dgraph_tpu.obs.footprint.
+    dtype_bytes` accepts)."""
+    name = getattr(dtype, "__name__", None) or str(dtype)
+    return {"bf16": "bfloat16", "f32": "float32", "f16": "float16"}.get(
+        name, name
+    )
+
+
+def degree_histogram(edge_index: np.ndarray, num_nodes: int) -> np.ndarray:
+    """[DEGREE_BUCKETS] int64 counts of vertices per log2 total-degree
+    bucket. Invariant under vertex renumbering and edge reordering."""
+    edge_index = np.asarray(edge_index)
+    deg = np.bincount(edge_index[0], minlength=num_nodes).astype(np.int64)
+    deg += np.bincount(edge_index[1], minlength=num_nodes)
+    hist = np.zeros(DEGREE_BUCKETS, dtype=np.int64)
+    nz = deg > 0
+    hist[0] = int(num_nodes - nz.sum())
+    if nz.any():
+        b = np.floor(np.log2(deg[nz])).astype(np.int64) + 1
+        np.add.at(hist, np.minimum(b, DEGREE_BUCKETS - 1), 1)
+    return hist
+
+
+def graph_signature(
+    edge_index: np.ndarray,
+    num_nodes: int,
+    world_size: int,
+    *,
+    dtype="float32",
+    feat_dim: int = 0,
+) -> dict:
+    """JSON-able signature dict for one (graph, topology, dtype) workload."""
+    edge_index = np.asarray(edge_index)
+    hist = degree_histogram(edge_index, num_nodes)
+    digest = hashlib.sha256(hist.tobytes()).hexdigest()[:16]
+    return {
+        "schema": SIGNATURE_SCHEMA_VERSION,
+        "num_nodes": int(num_nodes),
+        "num_edges": int(edge_index.shape[1]),
+        "world_size": int(world_size),
+        "dtype": canonical_dtype(dtype),
+        "feat_dim": int(feat_dim),
+        "degree_digest": digest,
+    }
+
+
+def signature_key(sig: dict) -> str:
+    """Stable 16-hex-char key of a signature dict (the record filename
+    stem). Key order is canonicalized so dict construction order can
+    never split the cache."""
+    payload = json.dumps(sig, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def signatures_match(a: dict, b: dict) -> bool:
+    """Field-by-field match (not just key equality — a record file renamed
+    or hand-edited must not adopt onto the wrong workload)."""
+    fields = (
+        "schema", "num_nodes", "num_edges", "world_size", "dtype",
+        "feat_dim", "degree_digest",
+    )
+    return all(a.get(f) == b.get(f) for f in fields)

@@ -1,0 +1,80 @@
+"""The tunable configuration space.
+
+Every knob here is one the framework already exposes — the tuner invents
+no new mechanisms, it only automates choices that were hand-picked
+constants: the partition method fed to :func:`dgraph_tpu.partition.
+partition_graph`, the ``pad_multiple`` fed to :func:`dgraph_tpu.plan.
+build_edge_plan`, and the serve :class:`~dgraph_tpu.serve.bucketing.
+BucketLadder` geometry. Halo lowering and Pallas-vs-XLA scatter are
+*derived* per winner (from the footprint cost model and the kernel-sweep
+log respectively), not enumerated here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point of the plan-build space."""
+
+    partition_method: str
+    pad_multiple: int
+
+    @property
+    def key(self) -> str:
+        return f"{self.partition_method}/pad{self.pad_multiple}"
+
+
+# pad_multiple candidates: the lane-tile ladder the codebase already uses
+# (8 = from_global default, 128 = bench/footprint convention, 256 = one
+# step of extra alignment headroom)
+PAD_MULTIPLES = (8, 128, 256)
+
+# partition methods cheap enough to enumerate host-side at tuning time;
+# 'multilevel' joins only when the native core is built (its python
+# fallback is greedy_bfs, which is already in the list)
+_METHODS = ("block", "random", "rcm", "greedy_bfs")
+
+
+def default_candidate(world_size: int) -> Candidate:
+    """The hard-coded defaults the tuner must beat (or tie): ``rcm`` +
+    ``pad_multiple=8`` (``DistributedGraph.from_global``). At world size 1
+    every partition degenerates to one block, so 'block' stands in — the
+    plan is identical and the partitioner is O(V) instead of a sparse
+    factorization."""
+    return Candidate("block" if world_size == 1 else "rcm", 8)
+
+
+def plan_candidates(
+    world_size: int,
+    methods: Optional[Sequence[str]] = None,
+    pad_multiples: Optional[Sequence[int]] = None,
+) -> list:
+    """Cartesian candidate list, default-candidate first (stable trace
+    order; ties in the analytic ranking resolve toward the default)."""
+    if methods is None:
+        if world_size == 1:
+            methods = ("block",)
+        else:
+            from dgraph_tpu import native
+
+            methods = _METHODS + (("multilevel",) if native.available() else ())
+    pads = tuple(pad_multiples) if pad_multiples is not None else PAD_MULTIPLES
+    cands = [Candidate(m, p) for m in methods for p in pads]
+    d = default_candidate(world_size)
+    if d in cands:
+        cands.remove(d)
+    cands.insert(0, d)
+    return cands
+
+
+# serve-ladder geometry space: (min_bucket, growth)
+LADDER_MIN_BUCKETS = (8, 16)
+LADDER_GROWTHS = (1.4, 2.0, 3.0)
+
+
+def ladder_candidates() -> list:
+    return [(m, g) for m in LADDER_MIN_BUCKETS for g in LADDER_GROWTHS]

@@ -141,6 +141,9 @@ def main(cfg: Config) -> dict:
         "batch_occupancy_mean": occ.get("mean"),
         "recompiles_since_warmup": engine.recompiles_since_warmup(),
         "buckets": [int(b) for b in engine.ladder.sizes],
+        # the adopted tuning record (dgraph_tpu.tune) these throughput
+        # numbers ran under, or None for the hard-coded defaults
+        "tuning_record": getattr(engine, "tuning_record_id", None),
         "config": dataclasses.asdict(cfg),
     }
     log.write(report)

@@ -1,118 +1,37 @@
 """Pick kernel tile winners from the sweep log (VERDICT r3 #1/#2).
 
-Reads ``logs/kernel_benchmarks.jsonl`` (the ``kernel_benchmarks.py
---sweep true`` output), prints the fastest (block_e, block_n) per
-(kernel, dtype) plus the XLA-vs-Pallas verdicts the config defaults hang
-on. Pure stdlib — runs with the TPU lease in any state.
+Thin wrapper: the winner-picking (including the NaN-row guard) now lives
+in ``dgraph_tpu/tune/adopt.py`` so the autotuner can consume the same
+measured data. This script keeps the historical entry point:
 
     python scripts/adopt_sweep.py [logs/kernel_benchmarks.jsonl]
+
+The module is loaded by file path, NOT via the package (whose __init__
+imports jax): pure stdlib, so the script keeps running with the TPU lease
+in any state — same discipline as bench.py's supervisor.
 """
 
 from __future__ import annotations
 
-import json
+import importlib.util
+import os
 import sys
-from collections import defaultdict
+
+
+def _load_adopt():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "dgraph_tpu", "tune", "adopt.py",
+    )
+    spec = importlib.util.spec_from_file_location("_dgraph_tune_adopt", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_dgraph_tune_adopt"] = mod
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def main(path: str = "logs/kernel_benchmarks.jsonl") -> None:
-    rows = []
-    with open(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if line.startswith("{"):
-                rows.append(json.loads(line))
-    if not rows:
-        raise SystemExit(f"no records in {path}")
-
-    # latest record wins for identical keys (the log is append-only)
-    def key(r, *names):
-        return tuple(r.get(n) for n in names)
-
-    sweep = defaultdict(dict)   # (op, dtype, F) -> {(be, bn): ms}
-    flat = {}                   # (op, dtype, F) -> ms (non-sweep rows)
-    for r in rows:
-        ms = r.get("ms")
-        # NaN rows mark per-op failures (a crashed compile, a noisy
-        # tunnel); min() over a dict containing NaN can crown the crashed
-        # tile as WINNER (every x < nan is False), so drop non-finite
-        if ms is None or ms != ms:
-            continue
-        k = key(r, "op", "dtype", "F")
-        if "block_e" in r:
-            sweep[k][(r["block_e"], r["block_n"])] = r["ms"]
-        else:
-            flat[k] = r["ms"]
-
-    print("== tile winners (lowest ms) ==")
-    winners = {}
-    for k, tiles in sorted(sweep.items()):
-        best = min(tiles, key=tiles.get)
-        winners[k] = best
-        ranked = sorted(tiles.items(), key=lambda kv: kv[1])
-        line = ", ".join(f"{be}x{bn}={ms:.3f}" for (be, bn), ms in ranked[:4])
-        print(f"{k[0]} [{k[1]} F={k[2]}]: WINNER block_e={best[0]} "
-              f"block_n={best[1]}  ({line})")
-
-    # the precision the framework actually DEPLOYS per dtype
-    # (ops/local.py: prec="highest" whenever dtype != bfloat16 — comparing
-    # the bf16-MXU "default" variant for f32 would judge a kernel that
-    # never runs in f32 training)
-    def deployed_scatter_op(dtype):
-        # kernel_benchmarks logs dtype as "bf16"/"f32"
-        is_bf16 = dtype in ("bf16", "bfloat16")
-        return ("segment_sum_pallas_default" if is_bf16
-                else "segment_sum_pallas_highest")
-
-    print("\n== XLA vs Pallas verdicts (deployed precision per dtype) ==")
-    for k, ms_x in sorted(flat.items()):
-        op, dtype, F = k
-        if op == "segment_sum_xla":
-            pl_ops, flag = [deployed_scatter_op(dtype)], "use_pallas_scatter"
-        elif op == "gather_sorted_xla":
-            pl_ops = ["gather_sorted_pallas", "gather_sorted_pallas_sweep"]
-            flag = "use_pallas_gather"
-        else:
-            continue
-        best_p = None
-        for pl_op in pl_ops:
-            k_pl = (pl_op, dtype, F)
-            cands = [flat[k_pl]] if k_pl in flat else []
-            if k_pl in sweep:
-                cands.append(min(sweep[k_pl].values()))
-            for ms in cands:
-                best_p = ms if best_p is None else min(best_p, ms)
-        if best_p is None:
-            continue
-        verdict = "PALLAS" if best_p < ms_x else "XLA"
-        print(f"{flag} [{dtype} F={F}]: xla={ms_x:.3f} "
-              f"pallas={best_p:.3f} -> {verdict} ({ms_x / best_p:.2f}x)")
-
-    if winners:
-        # consensus tile across kernels/dtypes: the plan carries ONE
-        # (scatter_block_e, scatter_block_n) pair serving BOTH kernels, so
-        # each (kernel FAMILY, dtype, F) gets exactly one vote — counting
-        # both precision variants of the scatter would double-weight it
-        # against the gather
-        def family(op, dtype):
-            if op.startswith("segment_sum_pallas"):
-                return ("scatter", dtype) if op == deployed_scatter_op(
-                    dtype) else None
-            if op.startswith("gather_sorted_pallas"):
-                return ("gather", dtype)
-            return None
-
-        votes = defaultdict(int)
-        for (op, dtype, F), best in winners.items():
-            if family(op, dtype) is None:
-                continue
-            votes[best] += 1
-        if votes:
-            (be, bn), n = max(votes.items(), key=lambda kv: kv[1])
-            print(f"\n== consensus: block_e={be} block_n={bn} "
-                  f"({n}/{sum(votes.values())} family votes) ==")
-            print("adopt in: dgraph_tpu/plan.py (scatter_block_e/_n "
-                  "defaults) + PLAN_FORMAT_VERSION bump if changed")
+    _load_adopt().main(path)
 
 
 if __name__ == "__main__":

@@ -64,6 +64,17 @@ SECTIONS = [
      ["StepMetrics", "Metrics", "step_record"]),
     ("Observability: run health", "dgraph_tpu.obs.health",
      ["RunHealth", "classify_wedge", "startup_record"]),
+    ("Autotuning: signatures", "dgraph_tpu.tune.signature",
+     ["graph_signature", "signature_key", "degree_histogram"]),
+    ("Autotuning: records & adoption", "dgraph_tpu.tune.record",
+     ["TuningRecord", "lookup_record", "adopt_record",
+      "default_record_dir"]),
+    ("Autotuning: search", "dgraph_tpu.tune.search",
+     ["search", "candidate_cost", "choose_ladder", "SearchResult"]),
+    ("Autotuning: measured phase", "dgraph_tpu.tune.measure",
+     ["measure_plan_ms"]),
+    ("Autotuning: kernel-sweep winners", "dgraph_tpu.tune.adopt",
+     ["pick_winners", "sweep_report"]),
     ("Config & flags", "dgraph_tpu.config", None),
 ]
 
