@@ -384,16 +384,16 @@ def bench_gcn(dtype_name: str):
     )
     from dgraph_tpu.tune.signature import graph_signature
 
-    pad_multiple, record_id = 128, None
+    pad_multiple, record_id, tuned_halo_impl = 128, None, None
     sig = graph_signature(edge_index, V, 1, dtype=dtype_name, feat_dim=F)
     rec = lookup_record(sig)
     if rec is not None:
         tuned = adopt_record(rec)
         pad_multiple = tuned.get("pad_multiple", pad_multiple)
         record_id = rec.record_id
+        tuned_halo_impl = rec.config.get("halo_impl")
         log(f"tuning record {record_id} adopted "
-            f"(pad_multiple={pad_multiple}, "
-            f"halo_impl={rec.config.get('halo_impl')})")
+            f"(pad_multiple={pad_multiple}, halo_impl={tuned_halo_impl})")
     else:
         clear_adoption()
 
@@ -402,7 +402,17 @@ def bench_gcn(dtype_name: str):
     plan_np, _ = build_edge_plan(
         edge_index, part, world_size=1, edge_owner="dst",
         pad_multiple=pad_multiple,
+        overlap=True if tuned_halo_impl == "overlap" else None,
     )
+    # interior/boundary split of the workload (plan.py): the boundary
+    # fraction bounds the halo payload, the interior fraction bounds what
+    # the overlap lowering can hide it behind — reported next to the
+    # adopted record so the lowering choice is auditable from the JSON
+    from dgraph_tpu.plan import interior_boundary_edge_counts
+
+    edge_split = interior_boundary_edge_counts(plan_np)
+    log(f"edge split: interior {edge_split['interior_frac']:.3f} / "
+        f"boundary {edge_split['boundary_frac']:.3f}")
     log("moving plan to device...")
     plan = jax.tree.map(lambda leaf: jnp.asarray(np.asarray(leaf)[0]), plan_np)
     jax.block_until_ready(jax.tree.leaves(plan))
@@ -464,10 +474,15 @@ def bench_gcn(dtype_name: str):
     #     (read E.H, write V.H each)
     per_layer = 6 * (Ep * H + Vp * H) * b
     hbm_bytes = 2 * per_layer + 3 * (Vp * (F + H) * b)  # + input/proj streams
+    split_info = {
+        "interior_edge_frac": round(edge_split["interior_frac"], 4),
+        "boundary_edge_frac": round(edge_split["boundary_frac"], 4),
+        "tuned_halo_impl": tuned_halo_impl,
+    }
     if dt_ms != dt_ms:  # NaN timing: no roofline numbers (keep JSON valid;
         # the record id still rides along — a null metric must stay
         # attributable to the config that failed to produce it)
-        return dt_ms, {"tuning_record": record_id}
+        return dt_ms, {"tuning_record": record_id, **split_info}
     secs = dt_ms / 1e3
     tflops_s = model_flops / secs / 1e12
     gbps = hbm_bytes / secs / 1e9
@@ -477,6 +492,7 @@ def bench_gcn(dtype_name: str):
         "hbm_gbps_min": round(gbps, 1),
         "hbm_pct": round(100 * gbps / V5E_PEAK_HBM_GBPS, 1),
         "tuning_record": record_id,
+        **split_info,
     }
 
 
@@ -969,7 +985,14 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
 
     # Phase 1: cheap init probes in throwaway subprocesses (each one a
     # fresh process — no poisoned backend cache). The lease recovers on
-    # its own, so probe until half the budget is gone, then give up.
+    # its own sometimes — but r01–r05 showed ~1200s burned across 7
+    # probes on a lease that never came back, so the probe loop gets its
+    # OWN budget (--probe-budget-s / DGRAPH_BENCH_PROBE_BUDGET, default
+    # 300s), capped at half the total so phase 2 always keeps time. A
+    # wedged lease now fails fast with the same structured RunHealth
+    # record (every probe attempt is in it) instead of eating the round.
+    probe_budget = float(os.environ.get("DGRAPH_BENCH_PROBE_BUDGET", "300"))
+    phase1_start = time.time()
     want = _expected_platform()
     check = (f"assert jax.default_backend() == '{want}', "
              f"jax.default_backend()" if want else "pass")
@@ -987,7 +1010,7 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
     probe = [sys.executable, "-c",
              f"import jax, jax.numpy as jnp; {pin}jax.devices(); "
              f"{check}; float(jnp.ones((8, 128)).sum())"]
-    phase1_end = deadline - 0.5 * budget
+    phase1_end = min(phase1_start + probe_budget, deadline - 0.5 * budget)
     attempt = 0
     while True:
         attempt += 1
@@ -1018,9 +1041,13 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
         finally:
             child_proc[0] = None
         if time.time() >= phase1_end:
+            # report the window actually probed, not the configured knob —
+            # a small total budget can cap the probe phase shorter than
+            # the default, and the wedge record must say what happened
             return _supervisor_emit(
                 {}, f"backend never initialized within {attempt} probes "
-                    f"(~{budget // 2}s); wedged TPU lease")
+                    f"(~{int(phase1_end - phase1_start)}s probe window); "
+                    f"wedged TPU lease")
         time.sleep(min(45, max(5, phase1_end - time.time())))
 
     # Phase 2: the real bench, with the remaining budget minus a margin
@@ -1084,4 +1111,26 @@ if __name__ == "__main__":
     if os.environ.get("DGRAPH_BENCH_CHILD") == "1":
         _child_main()
     else:
+        import argparse
+
+        ap = argparse.ArgumentParser(
+            description="dgraph_tpu benchmark harness (one JSON line to "
+                        "stdout; see module docstring for env knobs)")
+        ap.add_argument(
+            "--probe-budget-s", type=float, default=None,
+            help="phase-1 backend-probe budget in seconds (default 300; a "
+                 "wedged TPU lease fails fast with a structured RunHealth "
+                 "record instead of burning the run budget on probes)")
+        ap.add_argument(
+            "--platform", default=None,
+            help="JAX_PLATFORMS passthrough for the probe and bench child "
+                 "(e.g. 'cpu' to validate off-chip, 'tpu' to require the "
+                 "chip); overrides the ambient env var")
+        args = ap.parse_args()
+        # thread through the environment: the supervisor, its probes, and
+        # the bench child all read the same knobs there
+        if args.platform is not None:
+            os.environ["JAX_PLATFORMS"] = args.platform
+        if args.probe_budget_s is not None:
+            os.environ["DGRAPH_BENCH_PROBE_BUDGET"] = str(args.probe_budget_s)
         sys.exit(main())

@@ -61,8 +61,59 @@ class _BaseComm:
         raise NotImplementedError
 
     # -- the differentiable primitives (L5) --
-    def halo_exchange(self, x, halo: HaloSpec):
-        return collectives.halo_exchange(x, halo, self.graph_axis)
+    def halo_exchange(self, x, halo: HaloSpec, deltas=None, impl=None):
+        """Exchange boundary features. ``deltas``/``impl`` (from the plan /
+        :func:`collectives.resolve_plan_impl`) select the lowering — resolve
+        once per call site and thread it, so one jitted step can never mix
+        lowerings (plan-less callers default to the padded all_to_all)."""
+        return collectives.halo_exchange(
+            x, halo, self.graph_axis, deltas=deltas, impl=impl
+        )
+
+    def halo_exchange_overlap(self, x, plan: EdgePlan):
+        """The overlap lowering's exchange: double-buffered ppermute rounds
+        whose [W*S, F] result the boundary takes index directly."""
+        return collectives.halo_exchange_overlap(
+            x, plan.halo, self.graph_axis, tuple(plan.halo_deltas)
+        )
+
+    def overlap_active(self, plan: EdgePlan) -> bool:
+        """True when this plan lowers its halo exchange as the
+        interior/boundary overlap schedule (models' routing predicate)."""
+        return collectives.overlap_active(plan, self.graph_axis)
+
+    def interior_take(self, x, plan: EdgePlan, side: str = "src"):
+        """Interior-subset per-edge rows from the local table (no
+        dependence on the in-flight exchange)."""
+        return collectives.interior_take(x, plan, side)
+
+    def boundary_take(self, x_or_halo, plan: EdgePlan, side: str = "src"):
+        """Boundary-subset per-edge rows (halo side reads the exchange
+        output buffer; owner side reads the local table)."""
+        return collectives.boundary_take(x_or_halo, plan, side)
+
+    def interior_scatter_sum(self, edata_int, plan: EdgePlan, side: str = "dst"):
+        return collectives.interior_scatter_sum(edata_int, plan, side)
+
+    def boundary_scatter_sum(self, edata_bnd, plan: EdgePlan, side: str = "dst"):
+        return collectives.boundary_scatter_sum(edata_bnd, plan, side)
+
+    def gather_scatter_overlap(self, x_local, halo_buf, plan: EdgePlan,
+                               edge_weight=None):
+        """Overlap-scheduled neighbor sum into the owner side (interior
+        from the local table while the boundary rounds fly, then merge)."""
+        return collectives.gather_scatter_overlap(
+            x_local, halo_buf, plan, edge_weight
+        )
+
+    def scatter_bias_relu_overlap(self, stream_local, halo_buf, bias,
+                                  plan: EdgePlan, side: str = "dst",
+                                  edge_weight=None):
+        """Overlap-scheduled fused Σ w·relu(stream + bias) aggregation."""
+        return collectives.scatter_bias_relu_overlap(
+            stream_local, halo_buf, bias, plan, side, self.graph_axis,
+            edge_weight,
+        )
 
     def gather(self, x, plan: EdgePlan, side: str = "src"):
         return collectives.gather(x, plan, side, self.graph_axis)

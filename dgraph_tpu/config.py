@@ -120,11 +120,23 @@ def resolve_compute_dtype(dtype):
 gather_col_block: int = int(os.environ.get("DGRAPH_TPU_GATHER_COL_BLOCK", "128"))
 
 # Halo exchange lowering: 'auto' (ppermute neighbor rounds when the plan's
-# active peer-delta set is sparse, else one padded all_to_all),
-# 'all_to_all', or 'ppermute'. Resolution precedence lives in
+# active peer-delta set is sparse, else one padded all_to_all; 'overlap'
+# — interior/boundary split with the boundary rounds hidden behind
+# interior aggregation — whenever the plan carries its OverlapSpec),
+# 'all_to_all', 'ppermute', or 'overlap'. Resolution precedence lives in
 # plan.resolve_halo_impl: this env pin > the adopted tuning record
 # (tuned_halo_impl below) > the cost-model heuristic.
 halo_impl: str = os.environ.get("DGRAPH_TPU_HALO_IMPL", "auto")
+
+# Edge-axis chunk count for the overlap lowering's interior aggregation
+# (comm.collectives._interior_chunks): 1 = one sorted segment-sum (the
+# default — XLA already overlaps a single independent op with in-flight
+# rounds, and chunk partial sums regroup float adds, costing bit-parity
+# with the serial path); >1 splits the interior sum so pieces interleave
+# with individual ppermute rounds (capped at the live-delta count).
+overlap_interior_chunks: int = int(
+    os.environ.get("DGRAPH_TPU_OVERLAP_CHUNKS", "1")
+)
 
 # Halo lowering chosen by an adopted TuningRecord (dgraph_tpu.tune):
 # set by tune.record.adopt_record, consulted by plan.resolve_halo_impl

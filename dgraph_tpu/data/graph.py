@@ -128,6 +128,13 @@ class DistributedGraph:
         # a falsy dir to a plain build, so this is the one call site either way
         from dgraph_tpu.train.checkpoint import cached_edge_plan
 
+        # an adopted record whose halo lowering is 'overlap' needs the plan
+        # to CARRY the interior/boundary split — pass the intent explicitly
+        # so the plan-cache fingerprint distinguishes spec-ful plans (None
+        # keeps the builder's env/record auto-resolution for everyone else)
+        overlap = True if (
+            record is not None and record.config.get("halo_impl") == "overlap"
+        ) else None
         plan, layout = cached_edge_plan(
             plan_cache_dir,
             new_edges,
@@ -135,6 +142,7 @@ class DistributedGraph:
             world_size=world_size,
             edge_owner=edge_owner,
             pad_multiple=pad_multiple,
+            overlap=overlap,
         )
         n_pad = plan.n_src_pad
         feats = shard_vertex_data(

@@ -84,6 +84,14 @@ class GraphConvLayer(nn.Module):
         def over_chunks(fn):
             return map_feature_chunks(fn, D)
 
+        # Overlap routing (plans carrying an interior/boundary split whose
+        # resolved halo lowering is 'overlap'): issue the boundary rounds
+        # FIRST, aggregate interior edges from the local tables while they
+        # fly, merge the landed boundary contributions last. Same math —
+        # relu is per-edge and the aggregation sums over a partitioned
+        # edge set — with the collective hidden behind the interior work.
+        use_overlap = self.comm.overlap_active(plan)
+
         if (
             self.activation is nn.relu
             and plan.homogeneous
@@ -94,6 +102,14 @@ class GraphConvLayer(nn.Module):
             )
             h_bias = h_d if owner == "dst" else h_s
             h_stream = h_s if owner == "dst" else h_d
+            if use_overlap:
+                halo_buf = self.comm.halo_exchange_overlap(h_stream, plan)
+                return over_chunks(
+                    lambda sl: self.comm.scatter_bias_relu_overlap(
+                        h_stream[:, sl], halo_buf[:, sl], h_bias[:, sl],
+                        plan, side=owner, edge_weight=edge_weight,
+                    )
+                )
             h_ext = self.comm.halo_extend(h_stream, plan, side=stream)
             return over_chunks(
                 lambda sl: self.comm.scatter_bias_relu(
@@ -104,6 +120,34 @@ class GraphConvLayer(nn.Module):
 
         separable = self.activation in (nn.relu, jax.nn.relu)
         if separable and self.aggregate_to != plan.halo_side:
+            if use_overlap:
+                owner = self.aggregate_to
+                h_halo = h_s if plan.halo_side == "src" else h_d
+                h_own = h_d if plan.halo_side == "src" else h_s
+                halo_buf = self.comm.halo_exchange_overlap(h_halo, plan)
+                from dgraph_tpu.comm.collectives import overlap_edge_weight
+
+                w_int, w_bnd = overlap_edge_weight(edge_weight, plan)
+
+                def chunked_ov(sl):
+                    m_i = self.comm.interior_take(
+                        h_halo[:, sl], plan, side=plan.halo_side
+                    ) + self.comm.interior_take(h_own[:, sl], plan, side=owner)
+                    m_i = self.activation(m_i)
+                    if w_int is not None:
+                        m_i = m_i * w_int[:, None]
+                    agg = self.comm.interior_scatter_sum(m_i, plan, side=owner)
+                    m_b = self.comm.boundary_take(
+                        halo_buf[:, sl], plan, side=plan.halo_side
+                    ) + self.comm.boundary_take(h_own[:, sl], plan, side=owner)
+                    m_b = self.activation(m_b)
+                    if w_bnd is not None:
+                        m_b = m_b * w_bnd[:, None]
+                    return agg + self.comm.boundary_scatter_sum(
+                        m_b, plan, side=owner
+                    )
+
+                return over_chunks(chunked_ov)
             hs_ext = self.comm.halo_extend(h_s, plan, side="src")
             hd_ext = self.comm.halo_extend(h_d, plan, side="dst")
 

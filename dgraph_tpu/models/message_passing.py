@@ -87,6 +87,16 @@ class MessagePassing(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, plan: EdgePlan) -> jax.Array:
-        halo = self.comm.halo_exchange(x, plan.halo)
+        # resolve the halo lowering ONCE from the plan (env pin > tuning
+        # record > heuristic, incl. the overlap double-buffered rounds
+        # when the plan carries an interior/boundary split) and thread it
+        # — the plan-less facade default would always pay the padded
+        # all_to_all
+        from dgraph_tpu.comm.collectives import resolve_plan_impl
+
+        impl = resolve_plan_impl(plan, self.comm.graph_axis)
+        halo = self.comm.halo_exchange(
+            x, plan.halo, deltas=plan.halo_deltas, impl=impl
+        )
         full = jnp.concatenate([x, halo], axis=0)
         return self.layer(full, plan)

@@ -35,7 +35,19 @@ class SAGEConv(nn.Module):
         # would run every [e_pad, F] take/scatter at double width (the
         # dtype-discipline rule — see tests/test_dtype_discipline.py)
         xa = x.astype(dt) if dt is not None else x
-        if plan.halo_side != "dst":
+        if plan.halo_side != "dst" and self.comm.overlap_active(plan):
+            # overlap route: boundary rounds go out first; the interior
+            # neighbor sum (reading only the local table) runs while they
+            # fly; boundary contributions merge once landed. One exchange
+            # per layer, chunk-local work exactly as below.
+            halo_buf = self.comm.halo_exchange_overlap(xa, plan)
+            agg = map_feature_chunks(
+                lambda sl: self.comm.gather_scatter_overlap(
+                    xa[:, sl], halo_buf[:, sl], plan
+                ),
+                F,
+            )
+        elif plan.halo_side != "dst":
             # feature-chunked neighbor sum (models/gcn.py rationale): the
             # per-edge op here is IDENTITY, so chunking is exact for any
             # activation; one full-width halo exchange, local work in

@@ -50,12 +50,12 @@ V5E_ICI_GBPS = 200.0
 
 def dtype_bytes(dtype) -> int:
     """Itemsize for numpy dtypes, jax dtypes, and the bf16 family names
-    numpy doesn't know."""
-    name = getattr(dtype, "__name__", None) or str(dtype)
-    table = {"bfloat16": 2, "bf16": 2}
-    if name in table:
-        return table[name]
-    return int(np.dtype(name).itemsize)
+    numpy doesn't know. (Canonical implementation lives in
+    :func:`dgraph_tpu.plan.dtype_nbytes` — the base layer — so plan-side
+    byte accounting never imports upward into obs.)"""
+    from dgraph_tpu.plan import dtype_nbytes
+
+    return dtype_nbytes(dtype)
 
 
 def _imbalance(per_shard: np.ndarray) -> dict:
@@ -87,7 +87,11 @@ def plan_footprint(
       param_count: when > 0, also accounts the per-step gradient-sync psum
         (ring all-reduce volume) at f32.
     """
-    from dgraph_tpu.plan import resolve_halo_impl
+    from dgraph_tpu.plan import (
+        interior_boundary_edge_counts,
+        plan_memory_usage,
+        resolve_halo_impl,
+    )
 
     W, S = plan.world_size, plan.halo.s_pad
     b = dtype_bytes(dtype)
@@ -100,25 +104,36 @@ def plan_footprint(
     recv_rows = real_counts.sum(axis=0)  # [W]
     real_rows = int(real_counts.sum())
     n_deltas = len(plan.halo_deltas)
-    # mirror the runtime's lowering choice (comm/collectives._use_ppermute):
-    # env pin > adopted tuning record > heuristic — the report must account
-    # the lowering the run actually executes, whoever chose it
-    impl, impl_source = resolve_halo_impl(W, plan.halo_deltas)
+    # mirror the runtime's lowering choice (comm.collectives.
+    # resolve_plan_impl): env pin > adopted tuning record > heuristic —
+    # the report must account the lowering the run actually executes,
+    # whoever chose it (incl. 'overlap' when the plan carries its split)
+    overlap_available = getattr(plan, "overlap", None) is not None
+    impl, impl_source = resolve_halo_impl(
+        W, plan.halo_deltas, overlap_available=overlap_available
+    )
+    edge_split = interior_boundary_edge_counts(plan)
 
     # one halo_exchange (the gather's comm leg); halo_scatter_sum (the
     # scatter's reverse leg / the exchange's transpose) moves the same.
     a2a_operand = W * S * row_bytes  # [W, S, F] per shard
     a2a_ici = (W - 1) * S * row_bytes  # self block never leaves the chip
     pp_operand = n_deltas * S * row_bytes  # one [S, F] per live delta
-    wire_per_shard = {"all_to_all": a2a_ici, "ppermute": pp_operand}
+    # the overlap lowering sends the same boundary-only round payloads as
+    # ppermute — its win is SCHEDULING (exposed time), not wire bytes
+    wire_per_shard = {
+        "all_to_all": a2a_ici, "ppermute": pp_operand, "overlap": pp_operand,
+    }
     chosen_wire = wire_per_shard.get(impl, 0)
     real_bytes = real_rows * row_bytes
     # analytic-min HBM streams per shard per exchange, LOWERING-AWARE:
     # the [W*S, F] halo output buffer is written either way, but only the
     # blocks the chosen lowering actually sends are gathered and read
-    # (all_to_all pads every peer; ppermute touches live deltas only;
-    # 'none' never gathers a send buffer at all).
-    sent_blocks = {"all_to_all": W, "ppermute": n_deltas}.get(impl, 0)
+    # (all_to_all pads every peer; ppermute/overlap touch live deltas
+    # only; 'none' never gathers a send buffer at all).
+    sent_blocks = {
+        "all_to_all": W, "ppermute": n_deltas, "overlap": n_deltas,
+    }.get(impl, 0)
     hbm_per_shard = (2 * sent_blocks + W) * S * row_bytes
 
     def _roofline(ici_bytes: float, hbm_bytes: float) -> dict:
@@ -130,7 +145,10 @@ def plan_footprint(
             "bound": "ici" if t_ici >= t_hbm else "hbm",
         }
 
-    operand_by_impl = {"all_to_all": a2a_operand, "ppermute": pp_operand}
+    operand_by_impl = {
+        "all_to_all": a2a_operand, "ppermute": pp_operand,
+        "overlap": pp_operand,
+    }
     exchange = {
         "impl": impl,
         "impl_source": impl_source,
@@ -149,6 +167,30 @@ def plan_footprint(
         "hbm_bytes_per_shard": hbm_per_shard,
         "roofline": _roofline(chosen_wire, hbm_per_shard),
     }
+    if n_deltas:
+        # overlapped-schedule pricing (arxiv 2112.01075 / 2504.18658
+        # framing): the exchange runs as n_deltas boundary rounds with the
+        # interior aggregation interleaved, so the EXPOSED cost per round
+        # is max(round comm, its interior compute share), not their sum.
+        # Interior compute is modeled as the 3 HBM streams of the
+        # interior-edge rows one exchange leg drives (take write, read,
+        # reduce write — the per-leg half of search.py's 6-stream model).
+        int_rows_max = max(edge_split["interior_per_shard"] or [0])
+        round_comm_us = (S * row_bytes) / (ici_gbps * 1e3) if ici_gbps else 0.0
+        interior_us = (
+            3 * int_rows_max * row_bytes / (hbm_gbps * 1e3) if hbm_gbps else 0.0
+        )
+        per_round_int = interior_us / n_deltas
+        exposed = n_deltas * max(round_comm_us, per_round_int)
+        serial = n_deltas * round_comm_us + interior_us
+        exchange["overlap"] = {
+            "rounds": n_deltas,
+            "round_comm_us": round(round_comm_us, 3),
+            "interior_compute_us": round(interior_us, 3),
+            "exposed_us": round(exposed, 3),
+            "serial_us": round(serial, 3),
+            "hidden_us": round(serial - exposed, 3),
+        }
 
     psum = None
     if param_count:
@@ -202,6 +244,14 @@ def plan_footprint(
             "vertex_tensor_bytes": int(plan.n_src_pad) * row_bytes,
             "halo_buffer_bytes": W * S * row_bytes,
         },
+        # interior/boundary live-edge split: the boundary fraction bounds
+        # the collective payload, the interior fraction bounds how much
+        # compute the overlap lowering can hide it behind
+        "edge_split": edge_split,
+        "overlap_available": overlap_available,
+        # runtime-buffer accounting at the ACTUAL activation dtype (the
+        # plan_memory_usage satellite: a bf16 run must not be billed f32)
+        "plan_memory": plan_memory_usage(plan, F, dtype=dtype),
         "roofline_constants": {"ici_gbps": ici_gbps, "hbm_gbps": hbm_gbps},
     }
 
@@ -224,6 +274,8 @@ class Config:
     dtype: str = "float32"
     partition: str = "block"  # any dgraph_tpu.partition method
     pad_multiple: int = 128
+    overlap: bool = False  # build the interior/boundary split and price
+    # the overlapped schedule (False still follows an env/record pin)
     seed: int = 0
     param_count: int = 0  # >0: also account the grad-sync psum
     indent: int = 2  # 0 = one JSON line
@@ -243,7 +295,7 @@ def main(cfg: Config) -> dict:
     )
     plan, _ = build_edge_plan(
         new_edges, ren.partition, world_size=cfg.world,
-        pad_multiple=cfg.pad_multiple,
+        pad_multiple=cfg.pad_multiple, overlap=cfg.overlap or None,
     )
     report = plan_footprint(
         plan, cfg.dtype, cfg.feat_dim, param_count=cfg.param_count

@@ -281,6 +281,67 @@ class HaloSpec:
 
 
 @pytree_dataclass(
+    static=("e_int_pad", "e_bnd_pad", "interior_mc", "boundary_mc")
+)
+class OverlapSpec:
+    """Interior/boundary edge split for the compute–communication-overlap
+    halo lowering (the reference's internal/boundary split,
+    ``_NCCLCommPlan.py:14``, lifted into the padded SPMD plan).
+
+    Per rank, the plan's live edges are partitioned into **interior**
+    edges (both endpoints local — no halo slot referenced) and
+    **boundary** edges (halo-side endpoint remote). Each subset keeps the
+    plan's owner-sorted edge order (a subsequence of a monotone sequence
+    is monotone), so owner-side aggregation over either subset still
+    rides the sorted segment-sum fast path. The split lets the hot path
+    issue the boundary collective first, aggregate interior edges while
+    it is in flight, and merge boundary contributions last
+    (``comm.collectives.halo_exchange_overlap`` / ``scatter_sum_overlap``).
+
+    Index conventions (per rank shard):
+
+    - ``int_src``/``int_dst``: as ``EdgePlan.src_index``/``dst_index``
+      restricted to interior edges; halo-side entries are plain local row
+      ids (< ``n_halo_pad``). Padded slots carry the owner-side fill
+      ``n_owner_pad`` (monotone tail) / halo-side fill ``n_halo_pad``
+      (out of range -> zero rows on take).
+    - ``bnd_src``/``bnd_dst``: boundary edges; the halo-side entry is
+      REBASED into the halo buffer, i.e. ``slot - n_halo_pad`` in
+      ``[0, W*s_pad)`` — it indexes the ``[W*S, F]`` exchange output
+      directly, no ``[local ; halo]`` concat needed. Padded halo-side
+      slots carry ``W*s_pad`` (out of range).
+    - ``int_epos``/``bnd_epos``: position of each subset edge within the
+      plan's ``[0, e_pad)`` edge axis (fill ``e_pad``), for subsetting
+      per-edge data (edge weights, plan-layout messages) by take.
+    """
+
+    int_src: Any  # i32[W, Ei]
+    int_dst: Any  # i32[W, Ei]
+    int_mask: Any  # f32[W, Ei]
+    int_epos: Any  # i32[W, Ei]
+    bnd_src: Any  # i32[W, Eb]
+    bnd_dst: Any  # i32[W, Eb]
+    bnd_mask: Any  # f32[W, Eb]
+    bnd_epos: Any  # i32[W, Eb]
+    num_interior: Any  # i32[W]
+    num_boundary: Any  # i32[W]
+    e_int_pad: int
+    e_bnd_pad: int
+    # Pallas max-chunks hints for owner-side sorted segment-sums over each
+    # subset (same contract as EdgePlan.scatter_mc, computed for the same
+    # recorded block sizes)
+    interior_mc: int = 1
+    boundary_mc: int = 1
+
+    def side(self, which: str, side: str):
+        """The ``side`` ('src'/'dst') index array of subset ``which``
+        ('interior'/'boundary')."""
+        if which == "interior":
+            return self.int_src if side == "src" else self.int_dst
+        return self.bnd_src if side == "src" else self.bnd_dst
+
+
+@pytree_dataclass(
     static=(
         "world_size",
         "n_src_pad",
@@ -366,6 +427,12 @@ class EdgePlan:
     # edge chunk spans (ops.pallas_segment.sorted_row_gather). 0 on plans
     # predating the kernel (stale caches rebuild via PLAN_FORMAT_VERSION).
     gather_mv: int = 0
+    # Interior/boundary edge split for the compute–communication-overlap
+    # lowering (an :class:`OverlapSpec`), or None on plans built without
+    # it. Built on request (build_edge_plan(overlap=True)) or when the
+    # resolved halo lowering asks for it (env pin / adopted tuning record
+    # — see resolve_halo_impl); costs ~2x the plan's per-edge index bytes.
+    overlap: Any = None
 
     def ids_sorted(self, side: str) -> bool:
         """True iff this side's per-edge index is monotone: the OWNER side
@@ -377,18 +444,43 @@ class EdgePlan:
         return self.owner_sorted and side != self.halo_side
 
 
-def plan_memory_usage(plan: EdgePlan, feature_dim: int, dtype_bytes: int = 4) -> dict:
+def dtype_nbytes(dtype) -> int:
+    """Itemsize for numpy dtypes, jax dtypes, and the bf16 family names
+    numpy doesn't know. Lives HERE (the base layer) so both this module's
+    byte accounting and ``obs.footprint``'s (which re-exports it as
+    ``dtype_bytes``) share one table without a downward import."""
+    name = getattr(dtype, "__name__", None) or str(dtype)
+    if name in ("bfloat16", "bf16"):
+        return 2
+    return int(np.dtype(name).itemsize)
+
+
+def plan_memory_usage(
+    plan: EdgePlan, feature_dim: int, dtype_bytes: int = 4, *, dtype=None
+) -> dict:
     """Byte accounting of a plan and its runtime buffers — parity with
     ``NCCLGraphCommPlan.memory_usage`` (``_NCCLCommPlan.py:68-100``), printed
     by the reference before training (``Trainer.py:113-123``).
 
+    ``dtype`` (a numpy/jax dtype or its name, e.g. ``"bfloat16"``), when
+    given, overrides ``dtype_bytes`` — the runtime buffers scale with the
+    ACTIVATION dtype, and the old fixed-4-bytes default silently doubled
+    every bf16 accounting. ``obs.footprint`` passes the activation dtype
+    through here.
+
     Returns per-shard byte counts (every shard is identical in the padded
     design, unlike the reference's per-rank variable sizes).
     """
+    if dtype is not None:
+        dtype_bytes = dtype_nbytes(dtype)
     W, S = plan.world_size, plan.halo.s_pad
     idx_bytes = plan.e_pad * 4 * 2 + plan.e_pad * 4  # src/dst idx + mask
     if plan.halo_sort_perm is not None:
         idx_bytes += plan.e_pad * 4 * 2  # halo_sort_perm + halo_sorted_ids
+    ov = getattr(plan, "overlap", None)
+    if ov is not None:
+        # interior/boundary split: src+dst+epos (i32) + mask (f32) per slot
+        idx_bytes += (ov.e_int_pad + ov.e_bnd_pad) * 4 * 4
     send_bytes = W * S * (4 + 4)  # send_idx + send_mask
     halo_buffer = W * S * feature_dim * dtype_bytes
     send_buffer = W * S * feature_dim * dtype_bytes
@@ -399,6 +491,33 @@ def plan_memory_usage(plan: EdgePlan, feature_dim: int, dtype_bytes: int = 4) ->
         "send_buffer_bytes": send_buffer,
         "edge_buffer_bytes": edge_buffer,
         "total_runtime_bytes": halo_buffer + send_buffer + edge_buffer,
+        "dtype_bytes": dtype_bytes,
+    }
+
+
+def interior_boundary_edge_counts(plan: EdgePlan) -> dict:
+    """Per-shard interior (both endpoints local) vs boundary (halo-side
+    endpoint remote) live-edge counts, derived from the plan's index
+    arrays — works on any plan, with or without an :class:`OverlapSpec`.
+    The fractions are what ``bench.py`` and ``obs.footprint`` report next
+    to the halo lowering: they bound how much compute the overlap
+    lowering has available to hide the boundary collective behind."""
+    halo_idx = np.asarray(
+        plan.src_index if plan.halo_side == "src" else plan.dst_index
+    )
+    n_halo_pad = plan.n_src_pad if plan.halo_side == "src" else plan.n_dst_pad
+    live = np.asarray(plan.edge_mask) > 0
+    boundary = ((halo_idx >= n_halo_pad) & live).sum(axis=1).astype(np.int64)
+    total = live.sum(axis=1).astype(np.int64)
+    interior = total - boundary
+    tot = int(total.sum())
+    return {
+        "interior_per_shard": [int(v) for v in interior],
+        "boundary_per_shard": [int(v) for v in boundary],
+        "interior_total": int(interior.sum()),
+        "boundary_total": int(boundary.sum()),
+        "interior_frac": float(interior.sum() / tot) if tot else 1.0,
+        "boundary_frac": float(boundary.sum() / tot) if tot else 0.0,
     }
 
 
@@ -422,18 +541,31 @@ def pick_halo_impl(world_size: int, halo_deltas: tuple) -> str:
     return "ppermute" if len(halo_deltas) <= max(1, world_size // 2) else "all_to_all"
 
 
-def resolve_halo_impl(world_size: int, halo_deltas: tuple) -> tuple[str, str]:
+def resolve_halo_impl(
+    world_size: int, halo_deltas: tuple, *, overlap_available: bool = False
+) -> tuple[str, str]:
     """The halo lowering the run will actually execute, plus who decided.
 
-    Returns ``(impl, source)`` with source one of:
+    Returns ``(impl, source)`` with impl one of ``'none'``,
+    ``'all_to_all'``, ``'ppermute'``, ``'overlap'`` and source one of:
 
     - ``'env'``       — ``DGRAPH_TPU_HALO_IMPL`` (or ``config.set_flags``)
       pins the lowering; the operator's word is final.
     - ``'record'``    — an adopted :class:`~dgraph_tpu.tune.record.
       TuningRecord` chose it (``config.tuned_halo_impl``).
-    - ``'heuristic'`` — :func:`pick_halo_impl`'s cost model.
+    - ``'heuristic'`` — :func:`pick_halo_impl`'s cost model (or, when the
+      plan carries an interior/boundary split, the overlap lowering: its
+      exposed comm time is never worse than the serial rounds it is built
+      from).
     - ``'plan'``      — the plan has no cross-rank traffic at all; there is
       nothing to choose (impl is ``'none'``).
+
+    ``overlap_available`` says whether the plan carries an
+    :class:`OverlapSpec` (``plan.overlap is not None``). An ``'overlap'``
+    pin (env or record) on a plan WITHOUT the split cannot lower — that
+    tier is skipped (logged once per process) and the NEXT tier decides
+    (an env-pin miss still honors an adopted record, then the heuristic),
+    never a silent wrong answer.
 
     Every consumer of the decision (``comm.collectives``'s runtime dispatch,
     ``obs.footprint``'s byte accounting, :func:`plan_efficiency`'s report)
@@ -444,11 +576,44 @@ def resolve_halo_impl(world_size: int, halo_deltas: tuple) -> tuple[str, str]:
 
     if not halo_deltas:
         return "none", "plan"
-    if _cfg.halo_impl in ("all_to_all", "ppermute"):
-        return _cfg.halo_impl, "env"
-    if _cfg.tuned_halo_impl in ("all_to_all", "ppermute"):
-        return _cfg.tuned_halo_impl, "record"
+    legal = ("all_to_all", "ppermute") + (("overlap",) if overlap_available else ())
+    for impl, source in (
+        (_cfg.halo_impl, "env"),
+        (_cfg.tuned_halo_impl, "record"),
+    ):
+        if impl in legal:
+            return impl, source
+        if impl == "overlap":  # pinned but the plan carries no split
+            _warn_overlap_unavailable(source)
+    if overlap_available:
+        return "overlap", "heuristic"
     return pick_halo_impl(world_size, halo_deltas), "heuristic"
+
+
+def resolve_overlap_intent() -> bool:
+    """Whether a plan built RIGHT NOW with ``overlap=None`` (auto) would
+    attach the interior/boundary split: the env pin or the adopted tuning
+    record asks for the overlap lowering. The ONE copy of this rule —
+    ``build_edge_plan``'s auto default and the plan cache's fingerprint
+    (``train.checkpoint.cached_edge_plan``) both resolve through here, so
+    what gets built and what the cache key claims was built can never
+    diverge."""
+    from dgraph_tpu import config as _cfg
+
+    return "overlap" in (_cfg.halo_impl, _cfg.tuned_halo_impl)
+
+
+_overlap_warned: set = set()
+
+
+def _warn_overlap_unavailable(source: str) -> None:
+    if source not in _overlap_warned:
+        _overlap_warned.add(source)
+        _logger.warning(
+            "halo_impl='overlap' requested by %s but the plan carries no "
+            "interior/boundary split (built without overlap=True); the "
+            "next resolution tier decides the lowering instead", source,
+        )
 
 
 def plan_efficiency(plan: EdgePlan, layout: EdgePlanLayout) -> dict:
@@ -467,7 +632,9 @@ def plan_efficiency(plan: EdgePlan, layout: EdgePlanLayout) -> dict:
     n_deltas = len(plan.halo_deltas)
     src_total = int(layout.src_counts.sum())
     dst_total = int(layout.dst_counts.sum())
-    impl, impl_source = resolve_halo_impl(W, plan.halo_deltas)
+    impl, impl_source = resolve_halo_impl(
+        W, plan.halo_deltas, overlap_available=plan.overlap is not None
+    )
     return {
         "edge_fill": real_edges / max(W * E, 1),
         "src_vertex_fill": src_total / max(W * plan.n_src_pad, 1),
@@ -544,9 +711,57 @@ def validate_plan(plan: EdgePlan) -> None:
             if not np_.array_equal(halo_idx[r][pr], sids[r]):
                 errors.append(f"halo_sorted_ids[{r}] != halo_index[perm]")
                 break
+    ov = plan.overlap
+    if ov is not None:
+        # interior/boundary split invariants: the two subsets must exactly
+        # tile the live edge set, interior halo-side ids must be local,
+        # boundary halo-side slots must land inside the halo buffer, and
+        # owner-side ids must stay monotone per subset (the property the
+        # overlap lowering's chunked sorted segment-sums rely on)
+        n_halo_pad = plan.n_src_pad if plan.halo_side == "src" else plan.n_dst_pad
+        n_owner_pad = plan.n_dst_pad if plan.halo_side == "src" else plan.n_src_pad
+        im = np_.asarray(ov.int_mask) > 0
+        bm = np_.asarray(ov.bnd_mask) > 0
+        n_int = np_.asarray(ov.num_interior)
+        n_bnd = np_.asarray(ov.num_boundary)
+        if not np_.array_equal(im.sum(1), n_int):
+            errors.append("overlap int_mask count != num_interior")
+        if not np_.array_equal(bm.sum(1), n_bnd):
+            errors.append("overlap bnd_mask count != num_boundary")
+        if not np_.array_equal(n_int + n_bnd, np_.asarray(plan.num_edges)):
+            errors.append("overlap split does not tile the live edge set")
+        int_halo = np_.asarray(ov.side("interior", plan.halo_side))
+        bnd_halo = np_.asarray(ov.side("boundary", plan.halo_side))
+        if int_halo[im].size and int_halo[im].max(initial=0) >= n_halo_pad:
+            errors.append("overlap interior halo-side id not local")
+        if bnd_halo[bm].size and (
+            bnd_halo[bm].min(initial=0) < 0
+            or bnd_halo[bm].max(initial=0) >= W * S
+        ):
+            errors.append(f"overlap boundary slot out of [0,{W * S})")
+        owner_side = "dst" if plan.halo_side == "src" else "src"
+        for which, epos in (
+            ("interior", np_.asarray(ov.int_epos)),
+            ("boundary", np_.asarray(ov.bnd_epos)),
+        ):
+            own = np_.asarray(ov.side(which, owner_side))
+            if plan.owner_sorted and (np_.diff(own, axis=1) < 0).any():
+                errors.append(f"overlap {which} owner ids not monotone")
+            if own.max(initial=0) > n_owner_pad:
+                errors.append(f"overlap {which} owner id > {n_owner_pad}")
+            msk = im if which == "interior" else bm
+            if epos[msk].size and epos[msk].max(initial=0) >= plan.e_pad:
+                errors.append(f"overlap {which} epos out of [0,{plan.e_pad})")
+            # epos strictly increasing within each rank's live region
+            # (subsets preserve the plan's edge order)
+            live_pairs = msk[:, 1:] & msk[:, :-1]
+            if live_pairs.size and (np_.diff(epos, axis=1) <= 0)[live_pairs].any():
+                errors.append(f"overlap {which} epos not strictly increasing")
     if errors:
         raise ValueError("invalid EdgePlan: " + "; ".join(errors))
-    impl, impl_source = resolve_halo_impl(W, plan.halo_deltas)
+    impl, impl_source = resolve_halo_impl(
+        W, plan.halo_deltas, overlap_available=plan.overlap is not None
+    )
     _logger.info(
         "validate_plan OK: W=%d e_pad=%d s_pad=%d; halo lowering=%s "
         "(decided by %s)", W, plan.e_pad, S, impl, impl_source,
@@ -596,13 +811,21 @@ def _pad_to(x: int, multiple: int) -> int:
 
 
 def _reject_incompatible_knobs(
-    pad_multiple: int, e_pad: Optional[int], s_pad: Optional[int]
+    pad_multiple: int, e_pad: Optional[int], s_pad: Optional[int],
+    overlap: Optional[bool] = None, sort_edges: bool = True,
 ) -> None:
     """Fail fast on tunable combinations that cannot lower cleanly, naming
     the conflicting knobs — the autotuner (and any caller sweeping plan
     geometry) must get a structured rejection here, not a shape error deep
     in ``_finalize_plan`` or a silent per-step re-pad inside the Pallas
     kernels. Raises ValueError."""
+    if overlap and not sort_edges:
+        raise ValueError(
+            "overlap=True conflicts with sort_edges=False: the "
+            "interior/boundary split's chunked interior aggregation relies "
+            "on owner-sorted edge order (monotone segment ids per subset); "
+            "drop one of the two knobs"
+        )
     if pad_multiple < 1:
         raise ValueError(f"pad_multiple={pad_multiple} must be >= 1")
     if e_pad is not None:
@@ -674,6 +897,10 @@ def build_edge_plan(
     use_native: Optional[bool] = None,  # None = auto (E >= NATIVE_PLAN_MIN_EDGES)
     sort_route: Optional[bool] = None,  # None = auto (skip at billion-edge
     # scale: the two extra [W, E] int32 arrays aren't worth host RAM there)
+    overlap: Optional[bool] = None,  # None = auto: build the
+    # interior/boundary split when the configured halo lowering asks for
+    # it (env pin DGRAPH_TPU_HALO_IMPL=overlap or an adopted tuning
+    # record's tuned_halo_impl='overlap'); True/False force it
 ) -> tuple[EdgePlan, EdgePlanLayout]:
     """Build the padded SPMD plan for one edge set.
 
@@ -685,13 +912,18 @@ def build_edge_plan(
       edge_owner: 'dst' (TPU-native default: local aggregations) or 'src'
         (reference parity, ``commInfo.py:64-78``).
       pad_multiple: round padded sizes up to this multiple (TPU lane tiling).
+      overlap: attach an :class:`OverlapSpec` (interior/boundary edge
+        split) so the runtime can lower the halo exchange as overlappable
+        ppermute rounds hidden behind interior aggregation.
 
     Returns (plan, layout).
     """
     edge_index = np.asarray(edge_index)
     if edge_index.ndim != 2 or edge_index.shape[0] != 2:
         raise ValueError(f"edge_index must be [2, E], got {edge_index.shape}")
-    _reject_incompatible_knobs(pad_multiple, e_pad, s_pad)
+    if overlap is None:
+        overlap = resolve_overlap_intent()
+    _reject_incompatible_knobs(pad_multiple, e_pad, s_pad, overlap, sort_edges)
     src_partition = np.asarray(src_partition)
     homogeneous = dst_partition is None
     dst_partition = src_partition if homogeneous else np.asarray(dst_partition)
@@ -727,7 +959,7 @@ def build_edge_plan(
             src, dst, src_partition, dst_partition, src_offsets, dst_offsets,
             src_counts, dst_counts, W, edge_owner, homogeneous,
             n_src_pad, n_dst_pad, e_pad, s_pad, pad_multiple,
-            sort_route=sort_route,
+            sort_route=sort_route, overlap=overlap,
         )
 
     if edge_owner == "dst":  # validated above, before the native dispatch
@@ -857,7 +1089,7 @@ def build_edge_plan(
         owner_sorted=sort_edges,
         halo_deltas=tuple(int(d) for d in np.unique((needer - sender) % W)),
         edge_rank=edge_rank, edge_slot=edge_slot, halo_counts=halo_counts,
-        tag="", sort_route=sort_route,
+        tag="", sort_route=sort_route, overlap=overlap,
     )
 
 
@@ -866,6 +1098,7 @@ def _finalize_plan(
     send_idx, send_mask, s_pad_val, W, E, n_src_pad_val, n_dst_pad_val,
     e_pad_val, halo_side, homogeneous, edge_owner, owner_sorted, halo_deltas,
     edge_rank, edge_slot, halo_counts, tag: str, sort_route: bool,
+    overlap: bool = False,
 ) -> tuple[EdgePlan, EdgePlanLayout]:
     """Shared assembly tail of the numpy and native plan builders: Pallas
     scheduling hints, EdgePlan/EdgePlanLayout construction, efficiency log.
@@ -920,6 +1153,14 @@ def _finalize_plan(
             for r in range(W)
         )
 
+    overlap_spec = None
+    if overlap:
+        overlap_spec = _build_overlap_spec(
+            src_idx_arr, dst_idx_arr, edge_mask, halo_side,
+            n_src_pad_val, n_dst_pad_val, s_pad_val, W, e_pad_val,
+            owner_sorted, scatter_block_e, scatter_block_n,
+        )
+
     plan = EdgePlan(
         src_index=src_idx_arr,
         dst_index=dst_idx_arr,
@@ -943,6 +1184,7 @@ def _finalize_plan(
         halo_sorted_ids=halo_sorted_ids,
         halo_sort_mc=halo_sort_mc,
         gather_mv=gather_mv,
+        overlap=overlap_spec,
     )
     layout = EdgePlanLayout(
         edge_rank=edge_rank,
@@ -962,11 +1204,94 @@ def _finalize_plan(
     return plan, layout
 
 
+def _build_overlap_spec(
+    src_idx_arr, dst_idx_arr, edge_mask, halo_side, n_src_pad, n_dst_pad,
+    s_pad, W, e_pad, owner_sorted, scatter_block_e, scatter_block_n,
+) -> OverlapSpec:
+    """Derive the interior/boundary edge split from the assembled padded
+    index arrays — shared by the numpy and native builders (both feed the
+    same arrays through ``_finalize_plan``, so the split cannot diverge
+    between them). See :class:`OverlapSpec` for the index conventions."""
+    halo_idx = src_idx_arr if halo_side == "src" else dst_idx_arr
+    n_halo_pad = n_src_pad if halo_side == "src" else n_dst_pad
+    n_owner_pad = n_dst_pad if halo_side == "src" else n_src_pad
+    live = edge_mask > 0
+    is_bnd = live & (halo_idx >= n_halo_pad)
+    is_int = live & ~is_bnd
+    n_int = is_int.sum(axis=1).astype(np.int64)
+    n_bnd = is_bnd.sum(axis=1).astype(np.int64)
+    int_max = int(n_int.max(initial=1))
+    bnd_max = int(n_bnd.max(initial=1))
+    # subset padding follows the plan's edge-pad alignment rule (lane tile
+    # floor of 8; Pallas scatter-block alignment once at kernel scale)
+    e_int_pad = _pad_to(int_max, _edge_pad_align(int_max, 8))
+    e_bnd_pad = _pad_to(bnd_max, _edge_pad_align(bnd_max, 8))
+
+    def subset(sel, e_sub_pad):
+        epos = np.full((W, e_sub_pad), e_pad, np.int32)
+        s_arr = np.full((W, e_sub_pad), n_owner_pad if halo_side == "dst"
+                        else n_halo_pad, np.int32)
+        d_arr = np.full((W, e_sub_pad), n_owner_pad if halo_side == "src"
+                        else n_halo_pad, np.int32)
+        mask = np.zeros((W, e_sub_pad), np.float32)
+        for r in range(W):
+            pos = np.nonzero(sel[r])[0]
+            k = len(pos)
+            epos[r, :k] = pos
+            s_arr[r, :k] = src_idx_arr[r, pos]
+            d_arr[r, :k] = dst_idx_arr[r, pos]
+            mask[r, :k] = 1.0
+        return epos, s_arr, d_arr, mask
+
+    int_epos, int_src, int_dst, int_mask = subset(is_int, e_int_pad)
+    bnd_epos, bnd_src, bnd_dst, bnd_mask = subset(is_bnd, e_bnd_pad)
+    # rebase the boundary halo-side entry into the [0, W*s_pad) halo
+    # buffer (padded slots -> W*s_pad, out of range of the buffer)
+    bnd_halo = bnd_src if halo_side == "src" else bnd_dst
+    rebased = np.where(
+        bnd_mask > 0, bnd_halo - n_halo_pad, W * s_pad
+    ).astype(np.int32)
+    if halo_side == "src":
+        bnd_src = rebased
+    else:
+        bnd_dst = rebased
+    # interior halo-side padded fill must be OUT of the local table
+    # (n_halo_pad), which `subset` already wrote; owner-side padded fill is
+    # n_owner_pad (monotone tail) likewise. Pallas hints for the owner-side
+    # sorted reductions over each subset:
+    interior_mc = boundary_mc = 1
+    if owner_sorted:
+        from dgraph_tpu.ops.pallas_segment import max_chunks_hint
+
+        int_owner = int_dst if halo_side == "src" else int_src
+        bnd_owner = bnd_dst if halo_side == "src" else bnd_src
+        interior_mc = max(
+            max_chunks_hint(int_owner[r], n_owner_pad,
+                            block_e=scatter_block_e, block_n=scatter_block_n)
+            for r in range(W)
+        )
+        boundary_mc = max(
+            max_chunks_hint(bnd_owner[r], n_owner_pad,
+                            block_e=scatter_block_e, block_n=scatter_block_n)
+            for r in range(W)
+        )
+    return OverlapSpec(
+        int_src=int_src, int_dst=int_dst, int_mask=int_mask,
+        int_epos=int_epos,
+        bnd_src=bnd_src, bnd_dst=bnd_dst, bnd_mask=bnd_mask,
+        bnd_epos=bnd_epos,
+        num_interior=n_int.astype(np.int32),
+        num_boundary=n_bnd.astype(np.int32),
+        e_int_pad=e_int_pad, e_bnd_pad=e_bnd_pad,
+        interior_mc=interior_mc, boundary_mc=boundary_mc,
+    )
+
+
 def _build_edge_plan_native(
     src, dst, src_partition, dst_partition, src_offsets, dst_offsets,
     src_counts, dst_counts, W, edge_owner, homogeneous,
     n_src_pad, n_dst_pad, e_pad, s_pad, pad_multiple,
-    sort_route: bool,
+    sort_route: bool, overlap: bool = False,
 ) -> tuple[EdgePlan, EdgePlanLayout]:
     """Billion-edge path: the per-edge sort/dedup/fill runs in the native
     core (csrc plan_core_*, bounded-memory radix sorts) and numpy only
@@ -1020,6 +1345,7 @@ def _build_edge_plan_native(
         halo_deltas=tuple(int(d) for d in np.unique((needer_r - sender_r) % W)),
         edge_rank=edge_rank.astype(np.int64), edge_slot=edge_slot,
         halo_counts=halo_counts, tag=" (native core)", sort_route=sort_route,
+        overlap=overlap,
     )
 
 

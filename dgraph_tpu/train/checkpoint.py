@@ -135,7 +135,9 @@ def checkpoint_keys(ckpt_dir: str, step: Optional[int] = None):
 # Bump whenever EdgePlan's fields/defaults change shape or meaning: stale
 # cache pickles must REBUILD, not silently inherit new class defaults for
 # fields they were never built with (e.g. scatter_block_e).
-PLAN_FORMAT_VERSION = 6  # v6: e_pad aligned to lcm(pad_multiple,
+PLAN_FORMAT_VERSION = 7  # v7: overlap (interior/boundary OverlapSpec for
+# the compute–communication-overlap halo lowering);
+# v6: e_pad aligned to lcm(pad_multiple,
 # SCATTER_BLOCK_E) so pallas operands need no per-call re-pad copy;
 # v5: gather_mv (sorted-row-gather vblock hint);
 # v4: halo-side sorted route (halo_sort_perm / halo_sorted_ids /
@@ -186,14 +188,23 @@ def cached_edge_plan(
     # baked into the built plan, and build_edge_plan defaults them from
     # the env-overridable module constants — a warm cache would otherwise
     # silently ignore DGRAPH_TPU_SCATTER_BLOCK_E/N (ADVICE r2 #2).
+    # Likewise the RESOLVED overlap intent: overlap=None defaults from the
+    # env pin / adopted tuning record (plan.resolve_overlap_intent — the
+    # same rule the builder applies), and a warm spec-less pickle must
+    # not satisfy a build that now wants the interior/boundary split.
     from dgraph_tpu import plan as _plan
 
+    overlap_resolved = build_kwargs.get("overlap")
+    if overlap_resolved is None:
+        overlap_resolved = _plan.resolve_overlap_intent()
     key = _graph_fingerprint(
         edge_index,
         src_partition if dst_partition is None else np.concatenate([src_partition, dst_partition]),
         scatter_block_e=_plan.SCATTER_BLOCK_E,
         scatter_block_n=_plan.SCATTER_BLOCK_N,
-        **{k: v for k, v in build_kwargs.items() if np.isscalar(v) or isinstance(v, str)},
+        overlap=bool(overlap_resolved),
+        **{k: v for k, v in build_kwargs.items()
+           if k != "overlap" and (np.isscalar(v) or isinstance(v, str))},
     )
     path = os.path.join(cache_dir, f"plan_{key}.pkl")
     if os.path.exists(path):

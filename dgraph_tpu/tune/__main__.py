@@ -92,6 +92,7 @@ def _run_search(cfg: Config, log):
 
 def _selftest(cfg: Config, log) -> dict:
     """Compile-free end-to-end check of the whole subsystem."""
+    from dgraph_tpu import config as _dcfg
     from dgraph_tpu.tune.record import TuningRecord, adopt_record, lookup_record
     from dgraph_tpu.tune.signature import graph_signature
 
@@ -112,6 +113,57 @@ def _selftest(cfg: Config, log) -> dict:
             )
         if not any(t.get("phase") == "analytic" for t in result.trace):
             failures.append("no analytic trace rows emitted")
+
+        # overlap knob coverage (all analytic — no XLA compile): every
+        # priced candidate must carry the overlap-vs-serial numbers, and
+        # on a 2-shard graph with interior edges the exposed overlap cost
+        # strictly beats serial rounds, so the winner adopts it
+        priced = [
+            t for t in result.trace
+            if t.get("phase") == "analytic" and "overlap_exposed_us" in t
+        ]
+        if not priced:
+            failures.append("analytic trace rows carry no overlap pricing")
+        elif not all(
+            t["overlap_exposed_us"] <= t["exchange_us"] or
+            t["halo_impl"] != "overlap" for t in priced
+        ):
+            failures.append("an overlap winner priced above its exchange")
+        if rec.config.get("halo_impl") != "overlap":
+            failures.append(
+                f"2-shard workload with interior edges should adopt the "
+                f"overlap lowering, got {rec.config.get('halo_impl')!r}"
+            )
+
+        # the adopted record must round-trip tuned_halo_impl='overlap'
+        # through save -> load -> adopt (the knob is useless if the
+        # persisted winner cannot re-apply it next process)
+        reloaded_ov = TuningRecord.load(path)
+        saved_impl = _dcfg.tuned_halo_impl
+        try:
+            adopt_record(reloaded_ov)
+            if _dcfg.tuned_halo_impl != "overlap":
+                failures.append(
+                    f"adopt_record set tuned_halo_impl="
+                    f"{_dcfg.tuned_halo_impl!r}, expected 'overlap'"
+                )
+            from dgraph_tpu.plan import resolve_halo_impl
+
+            impl, source = resolve_halo_impl(2, (1,), overlap_available=True)
+            if (impl, source) != ("overlap", "record"):
+                failures.append(
+                    f"resolve_halo_impl under the adopted record returned "
+                    f"({impl!r}, {source!r}), expected ('overlap', 'record')"
+                )
+            # a plan WITHOUT the split must degrade, never half-lower
+            impl_no_spec, _ = resolve_halo_impl(2, (1,), overlap_available=False)
+            if impl_no_spec == "overlap":
+                failures.append(
+                    "resolve_halo_impl lowered 'overlap' on a plan without "
+                    "the interior/boundary split"
+                )
+        finally:
+            _dcfg.set_flags(tuned_halo_impl=saved_impl)
 
         # round trip: the persisted JSON reloads, validates, and is found
         # by a signature lookup

@@ -118,7 +118,9 @@ class TuningRecord:
             if pm is not None and (not isinstance(pm, int) or pm < 1):
                 errors.append(f"pad_multiple {pm!r} not a positive int")
             impl = self.config.get("halo_impl")
-            if impl is not None and impl not in ("none", "ppermute", "all_to_all"):
+            if impl is not None and impl not in (
+                "none", "ppermute", "all_to_all", "overlap"
+            ):
                 errors.append(f"halo_impl {impl!r} unknown")
             serve = self.config.get("serve")
             if serve is not None:
@@ -247,7 +249,9 @@ def adopt_record(rec: TuningRecord) -> dict:
 
     impl = rec.config.get("halo_impl")
     _cfg.set_flags(
-        tuned_halo_impl=impl if impl in ("ppermute", "all_to_all") else None
+        tuned_halo_impl=impl
+        if impl in ("ppermute", "all_to_all", "overlap")
+        else None
     )
     _cfg.set_flags(tuning_record_id=rec.record_id)
     _logger.info(

@@ -89,6 +89,7 @@ def candidate_cost(
     row = feat_dim * dtype_bytes(dtype)
     n_d = fp["num_halo_deltas"]
     wire = fp["halo"]["wire_bytes_per_shard"]
+    split = fp["edge_split"]
 
     def exch_bound(impl: str) -> float:
         sent_blocks = {"all_to_all": W, "ppermute": n_d}.get(impl, 0)
@@ -97,11 +98,33 @@ def candidate_cost(
         hbm_us = (2 * sent_blocks + W) * S * row / (hbm_gbps * 1e3)
         return max(wire_us, hbm_us)
 
+    # the overlap lowering moves ppermute's boundary-only rounds but hides
+    # them behind the interior-edge aggregation (3 HBM streams of interior
+    # rows per exchange leg — the per-leg half of the 6-stream local
+    # model), so its EXPOSED exchange cost is what serial rounds cost
+    # minus what the interior work can absorb
+    int_rows_max = max(split["interior_per_shard"] or [0])
+    interior_leg_us = 3 * int_rows_max * row / (hbm_gbps * 1e3)
+    overlap_exposed = 0.0
+    if n_d:
+        pp_us = exch_bound("ppermute")
+        overlap_exposed = max(pp_us - interior_leg_us, 0.0)
+
     if n_d == 0:
         impl, exch_us = "none", 0.0
     else:
-        a2a, pp = exch_bound("all_to_all"), exch_bound("ppermute")
-        impl, exch_us = ("ppermute", pp) if pp <= a2a else ("all_to_all", a2a)
+        bounds = {
+            "all_to_all": exch_bound("all_to_all"),
+            "ppermute": exch_bound("ppermute"),
+            "overlap": overlap_exposed,
+        }
+        # stable tie-break preserving the pre-overlap semantics: ppermute
+        # beats all_to_all on equal cost (as before), and overlap — equal
+        # to ppermute exactly when there is no interior work to hide
+        # behind — only wins when it actually hides something
+        order = ("ppermute", "all_to_all", "overlap")
+        impl = min(order, key=lambda k: (bounds[k], order.index(k)))
+        exch_us = bounds[impl]
 
     local_us = 6 * (plan.e_pad + plan.n_dst_pad) * row / (hbm_gbps * 1e3)
     return {
@@ -112,6 +135,11 @@ def candidate_cost(
         "e_pad": int(plan.e_pad),
         "s_pad": int(S),
         "num_halo_deltas": n_d,
+        # overlap-knob pricing: both alternatives land in the trace so the
+        # record's choice is auditable (overlap in {off, on} first-class)
+        "overlap_exposed_us": round(overlap_exposed, 3),
+        "interior_frac": split["interior_frac"],
+        "boundary_frac": split["boundary_frac"],
         "wire_efficiency": fp["collectives"]["halo_exchange"]["wire_efficiency"],
         "edge_imbalance": fp["imbalance"]["edges"]["max_over_mean"],
     }

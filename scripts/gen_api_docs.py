@@ -30,9 +30,10 @@ SECTIONS = [
     ("Device mesh", "dgraph_tpu.comm.mesh", None),
     ("Multi-host launch", "dgraph_tpu.comm.multihost", None),
     ("Communication plans", "dgraph_tpu.plan",
-     ["CommPattern", "EdgePlan", "build_edge_plan", "build_comm_pattern",
-      "compute_comm_map", "validate_plan", "plan_memory_usage",
-      "pick_halo_impl"]),
+     ["CommPattern", "EdgePlan", "OverlapSpec", "build_edge_plan",
+      "build_comm_pattern", "compute_comm_map", "validate_plan",
+      "plan_memory_usage", "interior_boundary_edge_counts",
+      "pick_halo_impl", "resolve_halo_impl"]),
     ("Partitioning", "dgraph_tpu.partition", None),
     ("Rank-local ops", "dgraph_tpu.ops.local", None),
     ("Pallas kernels", "dgraph_tpu.ops.pallas_segment",

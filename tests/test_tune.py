@@ -364,7 +364,7 @@ class TestSearch:
         cfg = result.record.config
         assert cfg["partition_method"] in ("block", "random", "rcm")
         assert cfg["pad_multiple"] in (8, 128)
-        assert cfg["halo_impl"] in ("none", "ppermute", "all_to_all")
+        assert cfg["halo_impl"] in ("none", "ppermute", "all_to_all", "overlap")
         assert cfg["serve"]["num_buckets"] >= 1
         # trace landed in the JSONL: one analytic row per candidate + result
         rows = [
