@@ -1,0 +1,367 @@
+"""Deterministic fault injection at host boundaries.
+
+PR 1-4 built the *detection* half of the resilience story (RunHealth wedge
+classification, ``StepWatchdog`` exiting :data:`~dgraph_tpu.train.elastic.
+WEDGED_EXIT_CODE`, corrupt-checkpoint fallback, serve backpressure) — but
+none of it was driven by reproducible faults.  This module is the missing
+*cause* side: named fault points at host boundaries that fire
+deterministically by step/call index, so every recovery path is testable
+bit-for-bit instead of waiting for a real lease wedge.
+
+Design rules:
+
+- **Host boundaries only.** A fault point is consulted between device
+  dispatches (checkpoint save/read, data load, step boundary, serving
+  dispatch) — never inside a traced function, so arming chaos changes zero
+  XLA programs and costs zero recompiles.
+- **Inert by default, near-zero overhead.** With ``DGRAPH_CHAOS`` unset and
+  nothing armed, :func:`fire` is one module-attribute read and a falsy
+  check.
+- **Deterministic.** A clause fires at an exact call/step index (``@K``),
+  optionally for ``count`` consecutive indices, optionally only on a given
+  supervisor ``attempt`` (the restart ordinal the train supervisor exports
+  as ``DGRAPH_CHAOS_ATTEMPT``).  Probabilistic clauses (``prob=``) draw
+  from a per-clause seeded RNG, so a given seed replays the identical
+  fault schedule.
+
+Spec grammar (``DGRAPH_CHAOS`` env var, or :func:`arm`)::
+
+    spec    := clause (';' clause)*
+    clause  := point '=' action '@' index (':' param '=' value)*
+    point   := one of KNOWN_POINTS (e.g. 'step', 'ckpt.save', 'grads')
+    action  := 'raise' | 'wedge' | 'sigterm' | 'poison'
+    index   := non-negative int: the call index (or caller-supplied step
+               index) at which the clause starts firing
+    params  := count=N    fire for N consecutive indices (default 1)
+               attempt=K  fire only on supervisor attempt K
+               sleep_s=S  wedge hold seconds (default 3600)
+               prob=P     fire with probability P at each index >= index
+               seed=S     RNG seed for prob clauses (default 0)
+
+Examples::
+
+    DGRAPH_CHAOS="step=wedge@3:sleep_s=60:attempt=0"   # wedge step 3, 1st run
+    DGRAPH_CHAOS="ckpt.save=raise@1;data.load=raise@0" # two points at once
+    DGRAPH_CHAOS="grads=poison@5"                      # NaN grads at step 5
+    DGRAPH_CHAOS="serve.infer=raise@0:count=2"         # 2 transient errors
+
+Actions: ``raise`` raises :class:`ChaosFault` (a transient host error);
+``wedge`` sleeps ``sleep_s`` in place, simulating the hung dispatch a lost
+TPU lease produces (the :class:`~dgraph_tpu.train.elastic.StepWatchdog`
+is what must catch it); ``sigterm`` delivers SIGTERM to this process (a
+simulated preemption, caught by :class:`~dgraph_tpu.train.elastic.
+PreemptionGuard`); ``poison`` makes :func:`fire` return True so the call
+site injects a non-finite value host-side (see :func:`poison_array`).
+
+Every RunHealth env snapshot records the active spec (or None) as its
+``chaos`` field, so a perf artifact can never silently include a
+fault-injected run (:mod:`dgraph_tpu.obs.health`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import signal
+import threading
+import time
+from typing import Optional
+
+ENV_VAR = "DGRAPH_CHAOS"
+# the supervisor's restart ordinal, exported to each child so a clause can
+# target one attempt (a wedge that re-fired on every resume would loop the
+# restart budget away)
+ATTEMPT_ENV_VAR = "DGRAPH_CHAOS_ATTEMPT"
+
+# point name -> where it is consulted (documentation + typo guard: a spec
+# naming an unknown point is rejected at parse time, not silently inert)
+KNOWN_POINTS = {
+    "ckpt.save": "train/checkpoint.py::save_checkpoint entry",
+    "ckpt.read": "train/checkpoint.py::restore_checkpoint entry",
+    "data.load": "data/graph.py::DistributedGraph.from_global entry",
+    "step": "train/elastic.py::run_elastic, before each step (index=step)",
+    "grads": "batch-owning loops, per step (poison -> non-finite grads)",
+    "serve.infer": "serve/engine.py::ServeEngine.infer, before dispatch",
+}
+
+ACTIONS = ("raise", "wedge", "sigterm", "poison")
+
+DEFAULT_WEDGE_SLEEP_S = 3600.0
+
+
+class ChaosFault(RuntimeError):
+    """The synthetic transient failure an armed ``raise`` clause throws."""
+
+    def __init__(self, point: str, index: int):
+        super().__init__(
+            f"chaos: injected fault at point {point!r} (call index {index})"
+        )
+        self.point = point
+        self.index = index
+
+    def record(self) -> dict:
+        """Structured JSONL form (the serve-errors ``record()`` discipline)."""
+        return {
+            "kind": "chaos_fault",
+            "point": self.point,
+            "index": self.index,
+            "detail": str(self),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class Clause:
+    """One parsed fault clause. See the module docstring for the grammar."""
+
+    point: str
+    action: str
+    index: int
+    count: int = 1
+    attempt: Optional[int] = None
+    sleep_s: float = DEFAULT_WEDGE_SLEEP_S
+    prob: Optional[float] = None
+    seed: int = 0
+
+    def matches(self, index: int, attempt: int, rng: Optional[random.Random]) -> bool:
+        if self.attempt is not None and attempt != self.attempt:
+            return False
+        if self.prob is not None:
+            # eligible from the start index on; one deterministic draw per
+            # eligible call keeps a given seed replaying the same schedule
+            if index < self.index:
+                return False
+            return rng.random() < self.prob
+        return self.index <= index < self.index + self.count
+
+
+def parse_spec(spec: str) -> tuple:
+    """Parse a ``DGRAPH_CHAOS`` spec into a tuple of :class:`Clause`.
+
+    Raises ValueError on unknown points/actions or malformed clauses — a
+    typo'd spec must fail loudly at arm time, not run fault-free.
+    """
+    clauses = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        head, _, params = raw.partition(":")
+        if "=" not in head or "@" not in head.split("=", 1)[1]:
+            raise ValueError(
+                f"chaos clause {raw!r} is not 'point=action@index[:k=v...]'"
+            )
+        point, rhs = head.split("=", 1)
+        action, idx_s = rhs.split("@", 1)
+        point, action = point.strip(), action.strip()
+        if point not in KNOWN_POINTS:
+            raise ValueError(
+                f"unknown chaos point {point!r} (known: "
+                f"{', '.join(sorted(KNOWN_POINTS))})"
+            )
+        if action not in ACTIONS:
+            raise ValueError(
+                f"unknown chaos action {action!r} (known: {', '.join(ACTIONS)})"
+            )
+        try:
+            index = int(idx_s)
+        except ValueError:
+            raise ValueError(f"chaos clause {raw!r}: index {idx_s!r} not an int")
+        if index < 0:
+            raise ValueError(f"chaos clause {raw!r}: index must be >= 0")
+        kw = {}
+        if params:
+            for pair in params.split(":"):
+                if "=" not in pair:
+                    raise ValueError(
+                        f"chaos clause {raw!r}: param {pair!r} is not k=v"
+                    )
+                k, v = pair.split("=", 1)
+                k = k.strip()
+                if k == "count":
+                    kw["count"] = int(v)
+                elif k == "attempt":
+                    kw["attempt"] = int(v)
+                elif k == "sleep_s":
+                    kw["sleep_s"] = float(v)
+                elif k == "prob":
+                    kw["prob"] = float(v)
+                elif k == "seed":
+                    kw["seed"] = int(v)
+                else:
+                    raise ValueError(
+                        f"chaos clause {raw!r}: unknown param {k!r} "
+                        "(count, attempt, sleep_s, prob, seed)"
+                    )
+        c = Clause(point=point, action=action, index=index, **kw)
+        if c.count < 1:
+            raise ValueError(f"chaos clause {raw!r}: count must be >= 1")
+        if c.prob is not None and not 0.0 <= c.prob <= 1.0:
+            raise ValueError(f"chaos clause {raw!r}: prob must be in [0, 1]")
+        clauses.append(c)
+    if not clauses:
+        raise ValueError(f"chaos spec {spec!r} contains no clauses")
+    return tuple(clauses)
+
+
+class _State:
+    """An armed fault plan: clauses + per-point call counters + per-clause
+    RNGs (prob clauses). One per process; counters are thread-safe."""
+
+    def __init__(self, clauses: tuple, spec: str, attempt: int):
+        self.clauses = clauses
+        self.spec = spec
+        self.attempt = attempt
+        self.counts: dict = {}
+        self.rngs = {
+            i: random.Random(c.seed)
+            for i, c in enumerate(clauses)
+            if c.prob is not None
+        }
+
+
+_LOCK = threading.Lock()
+# None = env not yet consulted; False = inert (cached); _State = armed
+_STATE = None
+
+
+def _resolve():
+    global _STATE
+    with _LOCK:
+        if _STATE is None:
+            spec = os.environ.get(ENV_VAR, "").strip()
+            if spec:
+                att = os.environ.get(ATTEMPT_ENV_VAR, "").strip()
+                _STATE = _State(parse_spec(spec), spec, int(att) if att else 0)
+            else:
+                _STATE = False
+        return _STATE
+
+
+def arm(spec: str, attempt: Optional[int] = None) -> None:
+    """Programmatically arm a fault plan (tests, selftest). ``attempt``
+    defaults to ``DGRAPH_CHAOS_ATTEMPT`` (0 when unset)."""
+    global _STATE
+    clauses = parse_spec(spec)
+    if attempt is None:
+        att = os.environ.get(ATTEMPT_ENV_VAR, "").strip()
+        attempt = int(att) if att else 0
+    with _LOCK:
+        _STATE = _State(clauses, spec, attempt)
+
+
+def disarm() -> None:
+    """Make every fault point inert (regardless of the env var)."""
+    global _STATE
+    with _LOCK:
+        _STATE = False
+
+
+def reset() -> None:
+    """Forget any armed/cached plan; the next :func:`fire` re-reads the
+    environment (tests that mutate ``DGRAPH_CHAOS`` in-process)."""
+    global _STATE
+    with _LOCK:
+        _STATE = None
+
+
+def active_spec() -> Optional[str]:
+    """The armed spec string, or None when inert — the value RunHealth env
+    snapshots record as their ``chaos`` field."""
+    st = _STATE
+    if st is None:
+        st = _resolve()
+    return st.spec if st else None
+
+
+def call_count(point: str) -> int:
+    """Calls observed at ``point`` since arming (diagnostics/selftest)."""
+    st = _STATE
+    return st.counts.get(point, 0) if st else 0
+
+
+def snapshot() -> dict:
+    """One JSON-able diagnostic record of the armed plan and its counters."""
+    st = _STATE
+    if st is None:
+        st = _resolve()
+    if not st:
+        return {"kind": "chaos", "spec": None}
+    return {
+        "kind": "chaos",
+        "spec": st.spec,
+        "attempt": st.attempt,
+        "counts": dict(st.counts),
+    }
+
+
+def fire(point: str, index: Optional[int] = None) -> bool:
+    """Consult fault point ``point``; returns True iff a ``poison`` clause
+    fired (the caller then injects the non-finite value host-side).
+
+    ``index=None`` uses (and advances) the per-process call counter for the
+    point; passing an explicit ``index`` (e.g. the global training step)
+    makes the schedule survive process restarts — a resumed run re-fires by
+    *global* step, and the ``attempt`` param is what keeps a wedge from
+    re-firing forever across restarts.
+
+    ``raise`` clauses raise :class:`ChaosFault`; ``wedge`` sleeps in place;
+    ``sigterm`` delivers SIGTERM to this process. Inert (nothing armed):
+    returns False at the cost of one attribute read.
+    """
+    st = _STATE
+    if st is None:
+        st = _resolve()
+    if not st:
+        return False
+    if point not in KNOWN_POINTS:
+        raise ValueError(f"unknown chaos point {point!r}")
+    with _LOCK:
+        seen = st.counts.get(point, 0)
+        st.counts[point] = seen + 1
+        idx = seen if index is None else int(index)
+        fired = [
+            c for i, c in enumerate(st.clauses)
+            if c.point == point and c.matches(idx, st.attempt, st.rngs.get(i))
+        ]
+    poison = False
+    for c in fired:
+        if c.action == "poison":
+            poison = True
+        elif c.action == "raise":
+            raise ChaosFault(point, idx)
+        elif c.action == "sigterm":
+            print(f"[chaos] SIGTERM at {point} index {idx}", flush=True)
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif c.action == "wedge":
+            print(
+                f"[chaos] wedging at {point} index {idx} for {c.sleep_s}s "
+                "(simulated hung dispatch)",
+                flush=True,
+            )
+            time.sleep(c.sleep_s)
+    return poison
+
+
+# --- poison helpers (host-side non-finite injection) ---
+
+
+def poison_array(arr):
+    """Copy of ``arr`` with its first element set to NaN (float arrays) —
+    the deterministic host-side poison a ``grads=poison@K`` clause asks the
+    batch-owning loop to apply to that step's inputs. Non-float arrays come
+    back unchanged (labels/masks of integer dtype cannot carry a NaN)."""
+    import numpy as np
+
+    a = np.array(arr, copy=True)
+    if a.dtype.kind != "f" or a.size == 0:
+        return a
+    a.reshape(-1)[0] = np.nan
+    return a
+
+
+def poison_pytree(tree):
+    """``poison_array`` over every float leaf of a pytree (dict batches)."""
+    import jax
+
+    return jax.tree.map(poison_array, tree)

@@ -1,0 +1,222 @@
+"""``python -m dgraph_tpu.chaos`` — fault-injection registry CLI.
+
+``--selftest`` (the tier-1 registration, compile-free like the tune/serve
+selftests) checks the whole registry contract in-process with hard
+assertions: grammar acceptance/rejection, exact-index firing, external
+(step) indices, attempt gating, count windows, seeded-probability
+determinism, poison injection, SIGTERM delivery, wedge sleeping, and the
+inert fast path.  Exit 0 only if every assertion holds; the result is one
+JSON line carrying a RunHealth record either way.
+
+``--show`` (default when no mode flag is given) prints the currently armed
+spec (from ``DGRAPH_CHAOS``) and the known fault points — the operator's
+"is chaos on?" probe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import signal
+import time
+
+
+@dataclasses.dataclass
+class Config:
+    """Chaos fault-injection registry (``--selftest`` for the compile-free
+    tier-1 smoke; default shows the armed spec and known points)."""
+
+    selftest: bool = False
+    indent: int = 0
+
+
+def _check(failures, cond, msg):
+    if not cond:
+        failures.append(msg)
+
+
+def _selftest() -> dict:
+    from dgraph_tpu import chaos
+
+    failures = []
+    try:
+        # --- grammar ---
+        cl = chaos.parse_spec("step=wedge@3:sleep_s=60:attempt=0;grads=poison@5")
+        _check(failures, len(cl) == 2, f"expected 2 clauses, got {len(cl)}")
+        _check(
+            failures,
+            cl[0].action == "wedge" and cl[0].index == 3
+            and cl[0].sleep_s == 60.0 and cl[0].attempt == 0,
+            f"wedge clause misparsed: {cl[0]}",
+        )
+        for bad in (
+            "nonsense",
+            "unknown.point=raise@0",
+            "step=explode@0",
+            "step=raise@-1",
+            "step=raise@x",
+            "step=raise@0:count=0",
+            "step=raise@0:prob=1.5",
+            "step=raise@0:bogus=1",
+            "",
+        ):
+            try:
+                chaos.parse_spec(bad)
+                failures.append(f"spec {bad!r} parsed but should be rejected")
+            except ValueError:
+                pass
+
+        # --- inert fast path ---
+        chaos.disarm()
+        _check(failures, chaos.fire("step") is False, "disarmed fire() fired")
+        _check(failures, chaos.active_spec() is None, "disarmed spec not None")
+
+        # --- exact-index raise via the per-point call counter ---
+        chaos.arm("ckpt.save=raise@2")
+        fired_at = []
+        for i in range(4):
+            try:
+                chaos.fire("ckpt.save")
+            except chaos.ChaosFault as e:
+                fired_at.append(i)
+                _check(failures, e.index == 2, f"fault index {e.index} != 2")
+                _check(
+                    failures, e.record()["kind"] == "chaos_fault",
+                    "ChaosFault.record() malformed",
+                )
+        _check(failures, fired_at == [2], f"raise fired at {fired_at}, want [2]")
+        _check(
+            failures, chaos.call_count("ckpt.save") == 4,
+            f"call_count {chaos.call_count('ckpt.save')} != 4",
+        )
+
+        # --- external (step) index + count window ---
+        chaos.arm("grads=poison@5:count=2")
+        got = [s for s in range(10) if chaos.fire("grads", index=s)]
+        _check(failures, got == [5, 6], f"poison window {got}, want [5, 6]")
+
+        # --- attempt gating (the supervisor's restart ordinal) ---
+        chaos.arm("step=raise@1:attempt=0", attempt=1)
+        try:
+            for s in range(4):
+                chaos.fire("step", index=s)
+        except chaos.ChaosFault:
+            failures.append("attempt=0 clause fired on attempt 1")
+        chaos.arm("step=raise@1:attempt=1", attempt=1)
+        try:
+            for s in range(4):
+                chaos.fire("step", index=s)
+            failures.append("attempt=1 clause never fired on attempt 1")
+        except chaos.ChaosFault:
+            pass
+
+        # --- seeded probability: deterministic schedule ---
+        def schedule():
+            chaos.arm("grads=poison@0:prob=0.5:seed=7")
+            return [s for s in range(32) if chaos.fire("grads", index=s)]
+
+        a, b = schedule(), schedule()
+        _check(failures, a == b, f"prob schedule not deterministic: {a} vs {b}")
+        _check(failures, 0 < len(a) < 32, f"prob=0.5 fired {len(a)}/32 times")
+
+        # --- poison helpers ---
+        import numpy as np
+
+        x = chaos.poison_array(np.ones(4, np.float32))
+        _check(
+            failures,
+            np.isnan(x[0]) and x.shape == (4,) and np.all(x[1:] == 1.0),
+            f"poison_array wrong: {x}",
+        )
+        y = chaos.poison_array(np.ones(3, np.int32))
+        _check(failures, np.all(y == 1), "poison_array touched an int array")
+        tree = chaos.poison_pytree({"x": np.ones(2, np.float64), "y": np.arange(2)})
+        _check(
+            failures,
+            np.isnan(tree["x"][0]) and tree["y"][0] == 0,
+            "poison_pytree wrong",
+        )
+
+        # --- sigterm delivery ---
+        seen = []
+        prev = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+        try:
+            chaos.arm("step=sigterm@0")
+            chaos.fire("step", index=0)
+            _check(failures, seen == [signal.SIGTERM], "SIGTERM not delivered")
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+        # --- wedge sleeps in place ---
+        chaos.arm("step=wedge@0:sleep_s=0.05")
+        t0 = time.monotonic()
+        chaos.fire("step", index=0)
+        _check(
+            failures, time.monotonic() - t0 >= 0.05,
+            "wedge returned before its sleep",
+        )
+
+        # --- snapshot + RunHealth env field ---
+        chaos.arm("step=raise@9")
+        snap = chaos.snapshot()
+        _check(failures, snap["spec"] == "step=raise@9", f"snapshot {snap}")
+        from dgraph_tpu.obs.health import RunHealth
+
+        env = RunHealth.begin("chaos.selftest").env
+        _check(
+            failures, env.get("chaos") == "step=raise@9",
+            f"RunHealth env chaos field = {env.get('chaos')!r}",
+        )
+        chaos.disarm()
+        env = RunHealth.begin("chaos.selftest").env
+        _check(
+            failures, env.get("chaos") is None,
+            "RunHealth env chaos field not None when inert",
+        )
+    finally:
+        chaos.reset()  # leave the process on env-driven behavior
+
+    return {"kind": "chaos_selftest", "failures": failures}
+
+
+def main(cfg: Config) -> dict:
+    from dgraph_tpu import chaos
+    from dgraph_tpu.obs.health import RunHealth
+
+    health = RunHealth.begin("chaos.cli")
+    if not cfg.selftest:
+        out = {
+            **chaos.snapshot(),
+            "known_points": dict(chaos.KNOWN_POINTS),
+            "run_health": health.finish(),
+        }
+        print(json.dumps(out, indent=cfg.indent or None))
+        return out
+    try:
+        out = _selftest()
+    except BaseException as e:  # every exit path carries a RunHealth record
+        rec = {
+            "kind": "chaos_selftest",
+            "failures": [f"crashed: {type(e).__name__}: {e}"],
+            "run_health": health.finish(
+                f"chaos selftest crashed: {type(e).__name__}: {e}",
+                wedge="stage_failure",
+            ),
+        }
+        print(json.dumps(rec, indent=cfg.indent or None))
+        raise
+    failures = out["failures"]
+    out["run_health"] = health.finish(
+        "; ".join(failures) if failures else None,
+        wedge="stage_failure" if failures else None,
+    )
+    print(json.dumps(out, indent=cfg.indent or None))
+    if failures:
+        raise SystemExit("chaos selftest FAILED: " + "; ".join(failures))
+    return out
+
+
+if __name__ == "__main__":
+    from dgraph_tpu.utils.cli import parse_config
+
+    main(parse_config(Config))

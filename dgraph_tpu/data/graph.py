@@ -81,6 +81,9 @@ class DistributedGraph:
         """
         if tune not in ("auto", "off"):
             raise ValueError(f"tune must be 'auto' or 'off', got {tune!r}")
+        from dgraph_tpu import chaos
+
+        chaos.fire("data.load")  # the partition/plan/shard host boundary
         num_nodes = features.shape[0]
         edge_index = np.asarray(edge_index)
         from dgraph_tpu.tune.record import (

@@ -103,6 +103,22 @@ def _env_snapshot() -> dict:
             out[k] = v
     # presence only: the value is a pool of internal tunnel IPs
     out["PALLAS_AXON_POOL_IPS_set"] = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+    # the ACTIVE fault-injection spec, not just the env var: chaos can be
+    # armed programmatically, and a perf artifact produced under injected
+    # faults must be identifiable from its health record alone. Looked up
+    # via sys.modules, NOT imported: bench.py's supervisor loads this file
+    # standalone precisely so it never triggers the package __init__'s jax
+    # import, and that must stay true (the env var is the fallback there).
+    import sys
+
+    chaos_mod = sys.modules.get("dgraph_tpu.chaos")
+    try:
+        out["chaos"] = (
+            chaos_mod.active_spec() if chaos_mod is not None
+            else (os.environ.get("DGRAPH_CHAOS") or None)
+        )
+    except Exception:  # never let diagnostics break the diagnosed run
+        out["chaos"] = None
     return out
 
 

@@ -26,8 +26,12 @@ from dgraph_tpu.plan import pytree_dataclass
 
 STEP_SCHEMA_VERSION = 1
 
-# fields serialized into / parsed out of a step record, in schema order
-_STEP_FIELDS = ("loss", "accuracy", "grad_norm", "mask_count")
+# fields serialized into / parsed out of a step record, in schema order.
+# nonfinite_skipped (0.0/1.0) is set only by guard-enabled steps
+# (train.loop.make_train_step(nonfinite_guard=True)) — additive, so
+# schema 1 readers are unaffected (unset fields never serialize).
+_STEP_FIELDS = ("loss", "accuracy", "grad_norm", "mask_count",
+                "nonfinite_skipped")
 
 
 @pytree_dataclass
@@ -43,6 +47,7 @@ class StepMetrics:
     accuracy: Any = None
     grad_norm: Any = None
     mask_count: Any = None
+    nonfinite_skipped: Any = None  # 0.0/1.0 from the non-finite step guard
 
     # dict-style access so call sites written against the legacy metrics
     # dict (``m["loss"]``) take a StepMetrics unchanged

@@ -42,6 +42,7 @@ from dgraph_tpu.serve.errors import (
     QueueFull,
     RequestTimeout,
     RequestTooLarge,
+    WorkerCrashed,
 )
 
 
@@ -79,6 +80,10 @@ class MicroBatcher:
         self._q: collections.deque = collections.deque()
         self._cv = threading.Condition()
         self._stopped = False
+        # requests popped from the queue but not yet resolved — reachable
+        # by the crash handler so a worker dying mid-batch can still fail
+        # them (they would otherwise hang until client timeout)
+        self._inflight: list = []
         self._worker = threading.Thread(
             target=self._loop, name="serve-batcher", daemon=True
         )
@@ -147,6 +152,19 @@ class MicroBatcher:
         """Blocking submit: logits [n, C], or raises the structured error."""
         return self.submit(node_ids, timeout_s).result()
 
+    @staticmethod
+    def _fail_future(fut: Future, err: Exception) -> None:
+        """Resolve a future with ``err`` unless the client already did
+        (done/cancelled). A bare done() pre-check is NOT enough: a client
+        can cancel() between the check and set_exception(), and the
+        InvalidStateError would abort whichever cleanup loop was running —
+        leaving the remaining futures hanging, the exact bug these loops
+        exist to prevent."""
+        try:
+            fut.set_exception(err)
+        except Exception:  # noqa: BLE001 — already resolved/cancelled: fine
+            pass
+
     def stop(self, join_timeout_s: float = 10.0) -> None:
         """Stop the worker (drains whatever is queued, rejecting anything
         still unserved at join timeout with :class:`EngineStopped`).
@@ -158,20 +176,61 @@ class MicroBatcher:
             self._stopped = True
             self._cv.notify_all()
         self._worker.join(timeout=join_timeout_s)
+        if self._worker.is_alive():
+            # the worker is wedged inside a dispatch: the in-flight batch
+            # will never resolve on its own — fail those waiters too (the
+            # queue drain below only covers never-popped requests)
+            with self._cv:
+                inflight, self._inflight = self._inflight, []
+            for p in inflight:
+                self._fail_future(
+                    p.future, EngineStopped("batcher stopped mid-flight")
+                )
         with self._cv:
             while self._q:
                 p = self._q.popleft()
-                if not p.future.done():
-                    p.future.set_exception(EngineStopped("batcher stopped"))
+                self._fail_future(p.future, EngineStopped("batcher stopped"))
 
     # --- worker side ---
 
     def _loop(self) -> None:
-        while True:
-            batch = self._collect()
-            if batch is None:
-                return
-            self._flush(batch)
+        # the whole worker body is fault-contained: a top-level exception
+        # (engine bug outside _flush's guarded call, metrics callback,
+        # collector fault) previously killed this thread SILENTLY and every
+        # queued/future request hung until client timeout. Now it fails all
+        # pending futures with the typed WorkerCrashed and marks the
+        # batcher stopped (submit rejects with EngineStopped from then on).
+        try:
+            while True:
+                batch = self._collect()
+                if batch is None:
+                    return
+                self._flush(batch)
+                self._inflight = []  # every future resolved; drop the refs
+        except BaseException as e:  # noqa: BLE001 — fail pending, then die
+            self._worker_crashed(e)
+
+    def _worker_crashed(self, exc: BaseException) -> None:
+        err = WorkerCrashed(
+            f"serve batcher worker crashed: {type(exc).__name__}: {exc}"
+        )
+        with self._cv:
+            self._stopped = True
+            pending = list(self._inflight) + list(self._q)
+            self._inflight = []
+            self._q.clear()
+            self._cv.notify_all()
+        for p in pending:
+            self._fail_future(p.future, err)
+        # best-effort observability: the registry itself may be what crashed
+        try:
+            self.registry.counter("serve.worker_crashed")
+        except Exception:  # noqa: BLE001
+            pass
+        import sys
+
+        print(f"[serve] {err} ({len(pending)} pending failed)",
+              file=sys.stderr, flush=True)
 
     def _collect(self):
         """Block until a batch is ready per the flush policy; None = exit."""
@@ -189,7 +248,11 @@ class MicroBatcher:
                 if remaining <= 0:
                     break
                 self._cv.wait(remaining)
-            batch, total = [], 0
+            # pop INTO the inflight list (not a local): anything that
+            # raises from here until _flush resolves the futures must leave
+            # them reachable for _worker_crashed
+            batch = self._inflight = []
+            total = 0
             cap = self.engine.ladder.max_size
             while self._q and len(batch) < self.max_batch_size:
                 nxt = self._q[0]
@@ -204,6 +267,16 @@ class MicroBatcher:
         now = time.monotonic()
         live = []
         for p in batch:
+            # a client-cancelled future is dropped exactly like an expired
+            # one: its client already gave up, and resolving a cancelled
+            # Future raises InvalidStateError — which the worker's crash
+            # containment would escalate into stopping the whole batcher
+            # (one impatient client must never take the queue down).
+            # set_running_or_notify_cancel() atomically claims the future,
+            # closing the race where cancel() lands after this check.
+            if not p.future.set_running_or_notify_cancel():
+                self.registry.counter("serve.rejected_cancelled")
+                continue
             if now > p.deadline:
                 self.registry.counter("serve.rejected_timeout")
                 p.future.set_exception(
@@ -217,7 +290,7 @@ class MicroBatcher:
             else:
                 live.append(p)
         if not live:
-            return  # expired-only batch: flush empty, no engine call
+            return  # expired/cancelled-only batch: flush empty, no engine call
         ids = np.concatenate([p.ids for p in live]) if len(live) > 1 else live[0].ids
         try:
             out = self.engine.infer(ids)

@@ -61,10 +61,24 @@ class ServeEngine:
         batch_args: Optional[Callable] = None,
         registry: Optional[Metrics] = None,
         tuning_record_id: Optional[str] = None,
+        max_retries: int = 2,
+        degrade_after: int = 3,
+        retry_backoff_s: float = 0.05,
     ):
         self.model = model
         self.mesh = mesh
         self.ladder = ladder or BucketLadder.geometric()
+        # self-healing knobs: a transient device error (lease blip, chaos
+        # injection) is retried up to max_retries times per request; after
+        # degrade_after CONSECUTIVE requests exhaust their retries the
+        # engine degrades — sheds every request as QueueFull until
+        # reset_degraded() — so a dead backend fails clients fast instead
+        # of burning a retry storm per request
+        self.max_retries = int(max_retries)
+        self.degrade_after = int(degrade_after)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.degraded = False
+        self._consecutive_failures = 0
         # provenance only (the ladder/plan themselves arrive already
         # built): stamped into serve_health so latency artifacts are
         # attributable to the tuning config that produced them
@@ -179,7 +193,20 @@ class ServeEngine:
         slices the padding back off. Raises
         :class:`~dgraph_tpu.serve.errors.RequestTooLarge` past the ladder
         and ValueError on out-of-range ids.
+
+        Self-healing: a transient device error is retried (same cached
+        executable — a retry can never compile) up to ``max_retries``
+        times with a short backoff; ``degrade_after`` consecutive
+        retry-exhausted requests flip the engine into DEGRADED mode, where
+        every request is shed fast with the structured
+        :class:`~dgraph_tpu.serve.errors.QueueFull` until
+        :meth:`reset_degraded`. The ``serve.infer`` chaos point
+        (:mod:`dgraph_tpu.chaos`) fires inside the retried section, which
+        is how both paths are tested deterministically.
         """
+        from dgraph_tpu import chaos
+        from dgraph_tpu.serve.errors import QueueFull, ServeError
+
         ids = np.asarray(node_ids)
         if ids.ndim != 1:
             raise ValueError(f"node_ids must be 1-D, got shape {ids.shape}")
@@ -188,16 +215,54 @@ class ServeEngine:
                 f"node ids must be in [0, {self.num_nodes}), got "
                 f"[{ids.min()}, {ids.max()}]"
             )
+        if self.degraded:
+            self.registry.counter("serve.shed_degraded")
+            raise QueueFull(
+                "engine degraded after repeated device failures; shedding "
+                "load (reset_degraded() to re-admit)",
+                degraded=True,
+                consecutive_failures=self._consecutive_failures,
+            )
         bucket = self.ladder.bucket_for(ids.shape[0])
         padded, n = pad_ids(ids, bucket)
-        rank_idx = jnp.asarray(self._id_rank[padded])
-        slot_idx = jnp.asarray(self._id_slot[padded])
         t0 = time.perf_counter()
-        with jax.set_mesh(self.mesh):
-            out = self._forwards[bucket](
-                self._params, self._batch, self._plan, rank_idx, slot_idx
-            )
-        out = np.asarray(jax.block_until_ready(out))[:n]
+        last_err = None
+        for attempt in range(self.max_retries + 1):
+            # index operands are rebuilt per attempt: they are DONATED to
+            # the executable, and a dispatch that failed midway may already
+            # have invalidated them
+            rank_idx = jnp.asarray(self._id_rank[padded])
+            slot_idx = jnp.asarray(self._id_slot[padded])
+            try:
+                chaos.fire("serve.infer")
+                with jax.set_mesh(self.mesh):
+                    out = self._forwards[bucket](
+                        self._params, self._batch, self._plan, rank_idx,
+                        slot_idx,
+                    )
+                out = np.asarray(jax.block_until_ready(out))[:n]
+                break
+            except ServeError:  # structured rejections are never transient
+                raise
+            except Exception as e:  # noqa: BLE001 — transient device error
+                last_err = e
+                if attempt < self.max_retries:
+                    self.registry.counter("serve.infer_retries")
+                    time.sleep(self.retry_backoff_s)
+        else:
+            self._consecutive_failures += 1
+            self.registry.counter("serve.infer_failures")
+            if self._consecutive_failures >= self.degrade_after:
+                self.degraded = True
+                self.registry.gauge("serve.degraded", 1.0)
+                print(
+                    f"[serve] engine DEGRADED after "
+                    f"{self._consecutive_failures} consecutive infer "
+                    f"failures (last: {type(last_err).__name__}: {last_err})",
+                    flush=True,
+                )
+            raise last_err
+        self._consecutive_failures = 0
         if _record:
             dt_ms = (time.perf_counter() - t0) * 1e3
             reg = self.registry
@@ -209,6 +274,14 @@ class ServeEngine:
                 float(self.recompiles_since_warmup()),
             )
         return out
+
+    def reset_degraded(self) -> None:
+        """Re-admit traffic after a degraded period (the operator's — or a
+        health-checker's — explicit decision: auto-undegrading would flap
+        against a still-dead backend)."""
+        self.degraded = False
+        self._consecutive_failures = 0
+        self.registry.gauge("serve.degraded", 0.0)
 
     def rank_slot(self, node_ids) -> tuple:
         """(rank, slot) arrays for original vertex ids — the row addresses

@@ -59,3 +59,13 @@ class EngineStopped(ServeError):
     """The batcher/engine was shut down while the request was in flight."""
 
     code = "stopped"
+
+
+class WorkerCrashed(ServeError):
+    """The micro-batcher's worker thread died on an unexpected exception
+    (engine bug, metrics callback, collector fault). Every pending and
+    in-flight request fails fast with this error and the batcher marks
+    itself stopped — the alternative (a silently dead worker) left every
+    queued future hanging until its client timeout."""
+
+    code = "worker_crashed"

@@ -44,6 +44,10 @@ def serve_health_record(
         "num_nodes": engine.num_nodes,
         "warmup_s": engine.warmup_s,
         "recompiles_since_warmup": engine.recompiles_since_warmup(),
+        # self-healing state: True means the engine is shedding every
+        # request as QueueFull after repeated device failures (the operator
+        # re-admits with reset_degraded())
+        "degraded": bool(getattr(engine, "degraded", False)),
         # the adopted tuning record (dgraph_tpu.tune) these latency numbers
         # were produced under, or None for the hard-coded defaults
         "tuning_record": getattr(engine, "tuning_record_id", None),

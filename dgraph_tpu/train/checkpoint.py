@@ -27,12 +27,18 @@ _logger = logging.getLogger("dgraph_tpu.checkpoint")
 
 
 def atomic_pickle_dump(path: str, obj: Any) -> None:
-    """Pickle to a temp file, then os.replace into place: concurrent readers
-    (multi-process launches polling a cache path) never see a truncated
-    artifact."""
+    """Pickle to a temp file, flush + fsync, then os.replace into place:
+    concurrent readers (multi-process launches polling a cache path) never
+    see a truncated artifact, and a HOST crash cannot leave a
+    durable-looking but empty/truncated file behind the rename — without
+    the fsync, os.replace can commit the name before the kernel commits
+    the data, and the post-crash filesystem shows a valid path holding
+    zero bytes."""
     tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         pickle.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 
@@ -40,7 +46,14 @@ def atomic_pickle_dump(path: str, obj: Any) -> None:
 
 
 def save_checkpoint(ckpt_dir: str, state: dict, step: int) -> None:
-    """Save a pytree (e.g. {'params':…, 'opt_state':…, 'step':…})."""
+    """Save a pytree (e.g. {'params':…, 'opt_state':…, 'step':…}).
+
+    Consults the ``ckpt.save`` chaos point (:mod:`dgraph_tpu.chaos`) at
+    entry — a ``raise`` clause simulates the save-side IO fault whose
+    recovery path is the restore-side fall-back-to-older-step."""
+    from dgraph_tpu import chaos
+
+    chaos.fire("ckpt.save")
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(os.path.join(ckpt_dir, f"step_{step:08d}"))
@@ -79,7 +92,14 @@ def restore_checkpoint(
     is strict: missing raises FileNotFoundError, unreadable raises the
     underlying error — silently serving an older checkpoint than the one
     NAMED would mislabel every downstream metric.
+
+    The ``ckpt.read`` chaos point fires at entry (a deterministic stand-in
+    for the torn-copy/unreadable-volume faults the fallback loop exists
+    for).
     """
+    from dgraph_tpu import chaos
+
+    chaos.fire("ckpt.read")
     import orbax.checkpoint as ocp
 
     steps = all_steps(ckpt_dir)

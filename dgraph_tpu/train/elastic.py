@@ -164,8 +164,10 @@ def run_elastic(
     ckpt_dir: Optional[str],
     checkpoint_every: int = 0,  # 0 = only on preemption/finish
     step_deadline_s: float = 0.0,  # 0 = no watchdog
+    first_deadline_s: Optional[float] = None,  # None = watchdog default (10x)
     is_lead: bool = True,
     guard: Optional[PreemptionGuard] = None,
+    rollback_on_abort: bool = True,
 ):
     """Drive ``train_step`` with preemption polling, periodic checkpoints,
     and an optional per-step wedge watchdog. Returns (state, last_step,
@@ -174,7 +176,27 @@ def run_elastic(
     The reference's trainers loop bare (``experiments/OGB/main.py:129-221``);
     this wrapper is what makes long runs restartable on preemptible TPU
     capacity. Resume by restoring the latest checkpoint and passing its
-    step as ``start_step`` (see ``train/checkpoint.py::latest_step``).
+    step as ``start_step`` (see ``train/checkpoint.py::latest_step``) — or
+    run the whole thing under ``python -m dgraph_tpu.train.supervise``,
+    which restarts on :data:`WEDGED_EXIT_CODE` and crashes for you.
+
+    ``first_deadline_s`` widens the FIRST step's watchdog allowance (trace +
+    XLA compile legitimately dwarf the steady-state step time); None keeps
+    :class:`StepWatchdog`'s 10x default. Callers whose first step compiles
+    a large program should pass their compile budget here rather than
+    inflating ``step_deadline_s`` for the whole run.
+
+    Each step consults the ``step`` chaos point (:mod:`dgraph_tpu.chaos`)
+    with the global step as the index, so injected wedges/preemptions/
+    crashes land deterministically even across restart+resume.
+
+    If ``train_step`` raises :class:`~dgraph_tpu.train.guard.
+    NonFiniteAbort` (the non-finite step guard's consecutive-skip abort)
+    and ``rollback_on_abort`` holds, the newest readable checkpoint is
+    restored and ``(restored_state, its_step, True)`` returned — the
+    caller decides whether to re-enter with a lower LR, different data
+    order, or give up. With no checkpoint to roll back to the abort
+    propagates.
 
     ``is_lead`` gates saves for SINGLE-controller runs (replicated or
     single-process state). In a multi-controller launch with state sharded
@@ -183,13 +205,18 @@ def run_elastic(
     coordinates lead-writes internally); gating to one process would
     deadlock or fail the save.
     """
+    from dgraph_tpu import chaos
     from dgraph_tpu.train.checkpoint import save_checkpoint
+    from dgraph_tpu.train.guard import NonFiniteAbort
 
     if start_step >= num_steps:  # nothing to do (e.g. resuming a finished run)
         return state, start_step, False
     own_guard = guard is None
     guard = guard or PreemptionGuard()
-    dog = StepWatchdog(step_deadline_s) if step_deadline_s > 0 else None
+    dog = (
+        StepWatchdog(step_deadline_s, first_deadline_s=first_deadline_s)
+        if step_deadline_s > 0 else None
+    )
     preempted = False
     step = start_step
     last_saved = None
@@ -203,7 +230,29 @@ def run_elastic(
 
     try:
         for step in range(start_step, num_steps):
-            state = train_step(state)
+            # fault injection lands HERE, at the host step boundary: a
+            # 'wedge' holds the loop exactly like a hung dispatch (only the
+            # watchdog can catch it), 'sigterm' exercises the preemption
+            # poll below, 'raise' the supervisor's crash-restart path
+            chaos.fire("step", index=step)
+            try:
+                state = train_step(state)
+            except NonFiniteAbort as e:
+                restored = (
+                    _rollback(ckpt_dir, state, dog)
+                    if rollback_on_abort and ckpt_dir else None
+                )
+                if restored is None:
+                    raise
+                import json as _json
+
+                print(
+                    _json.dumps(
+                        {**e.record(), "rolled_back_to": restored[1]}
+                    ),
+                    flush=True,
+                )
+                return restored[0], restored[1], True
             if dog is not None:
                 dog.beat()
             done_now = guard.should_stop()
@@ -224,3 +273,17 @@ def run_elastic(
         if own_guard:
             guard.uninstall()
     return state, step + 1, preempted
+
+
+def _rollback(ckpt_dir: str, state, dog: Optional[StepWatchdog]):
+    """Restore the newest readable checkpoint for the non-finite abort
+    path; None when the directory holds none. ``state`` is only the
+    restore TEMPLATE (structure/shapes — its buffers may already be
+    donated), never a value source."""
+    from dgraph_tpu.train.checkpoint import latest_step, restore_checkpoint
+
+    if latest_step(ckpt_dir) is None:
+        return None
+    with (dog.suspended() if dog is not None else contextlib.nullcontext()):
+        got = restore_checkpoint(ckpt_dir, {"state": state, "step": 0})
+    return got["state"], int(got["step"])

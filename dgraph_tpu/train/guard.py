@@ -1,0 +1,101 @@
+"""Host-side accounting for the non-finite step guard.
+
+The device half lives in ``train.loop.make_train_step(nonfinite_guard=
+True)``: the jitted step checks that the global gradient norm is finite and
+selects — with ``jnp.where``, inside the one already-compiled program, so a
+poisoned step and a clean step replay the same executable — between the
+applied update and the carried-forward state.  That makes a transient
+non-finite step (a bad batch row, a bfloat16 overflow spike) cost one
+skipped update instead of a destroyed run.
+
+This module is the host half: :class:`NonFiniteMonitor` counts skips as
+they stream out of the step's metrics and raises :class:`NonFiniteAbort`
+(a structured, JSONL-able abort) after N *consecutive* skips — a gradient
+stream that never recovers is not transient, and silently skipping forever
+would burn the whole step budget training nothing.  ``run_elastic``
+catches the abort and rolls back to the last checkpoint.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class NonFiniteAbort(RuntimeError):
+    """Raised after ``max_consecutive`` non-finite steps in a row; carries
+    the structured record the training driver logs before rolling back."""
+
+    def __init__(self, message: str, *, step: Optional[int] = None,
+                 consecutive: int = 0, total_skipped: int = 0):
+        super().__init__(message)
+        self.step = step
+        self.consecutive = consecutive
+        self.total_skipped = total_skipped
+
+    def record(self) -> dict:
+        """One JSONL-able dict (the serve-errors ``record()`` discipline)."""
+        return {
+            "kind": "nonfinite_abort",
+            "step": self.step,
+            "consecutive": self.consecutive,
+            "total_skipped": self.total_skipped,
+            "detail": str(self),
+        }
+
+
+class NonFiniteMonitor:
+    """Consecutive-skip counter over the guard's per-step skip flag.
+
+    Usage (the driver's step closure)::
+
+        monitor = NonFiniteMonitor(max_consecutive=3)
+        def train_step(state):
+            params, opt_state, m = step(params, opt_state, batch, plan)
+            monitor.observe(m["nonfinite_skipped"], step=state.step)
+            ...
+
+    ``observe`` coerces the device scalar to a bool on host (one scalar
+    transfer per step, only when the guard is enabled), returns it, and
+    raises :class:`NonFiniteAbort` once ``max_consecutive`` skips land in
+    a row.  A finite step resets the streak; ``total_skipped`` keeps the
+    lifetime count for the run's summary record.
+    """
+
+    def __init__(self, max_consecutive: int = 3):
+        if max_consecutive < 1:
+            raise ValueError(
+                f"max_consecutive must be >= 1, got {max_consecutive}"
+            )
+        self.max_consecutive = int(max_consecutive)
+        self.consecutive = 0
+        self.total_skipped = 0
+        self.last_skipped_step: Optional[int] = None
+
+    def observe(self, skipped, *, step: Optional[int] = None) -> bool:
+        s = bool(float(skipped))
+        if not s:
+            self.consecutive = 0
+            return False
+        self.consecutive += 1
+        self.total_skipped += 1
+        self.last_skipped_step = step
+        if self.consecutive >= self.max_consecutive:
+            raise NonFiniteAbort(
+                f"{self.consecutive} consecutive non-finite gradient steps "
+                f"(last at step {step}); aborting rather than skipping "
+                "forever",
+                step=step,
+                consecutive=self.consecutive,
+                total_skipped=self.total_skipped,
+            )
+        return True
+
+    def summary(self) -> dict:
+        """JSONL-able end-of-run summary of what the guard absorbed."""
+        return {
+            "kind": "nonfinite_guard",
+            "total_skipped": self.total_skipped,
+            "consecutive": self.consecutive,
+            "max_consecutive": self.max_consecutive,
+            "last_skipped_step": self.last_skipped_step,
+        }

@@ -121,6 +121,7 @@ def make_train_step(
     per_replica_batch: bool = False,
     batch_args: Callable = None,
     step_metrics: bool = False,
+    nonfinite_guard: bool = False,
 ):
     """Build a jitted SPMD train step: (params, opt_state, batch, plan) ->
     (params, opt_state, metrics).
@@ -131,6 +132,17 @@ def make_train_step(
     step's traced program is byte-identical to the flag not existing —
     zero overhead and zero extra recompiles when disabled (pinned by
     tests/test_obs.py).
+
+    ``nonfinite_guard=True`` adds an all-finite check on the global grad
+    norm and selects — via ``jnp.where`` inside the SAME traced program,
+    so a poisoned step and a clean step replay one executable with zero
+    recompiles (pinned by tests/test_obs.py) — between the applied update
+    and the carried-forward ``(params, opt_state)``.  The skip indicator
+    comes back in the metrics as ``nonfinite_skipped`` (0.0/1.0); feed it
+    to :class:`~dgraph_tpu.train.guard.NonFiniteMonitor` to abort after N
+    consecutive skips.  Like ``step_metrics`` this is a build-time
+    constant: disabled, the traced program is byte-identical to the flag
+    not existing.
 
     ``batch`` is a dict pytree with leading-[W] leaves (from
     ``DistributedGraph.batch`` + labels); params/opt_state are replicated.
@@ -216,15 +228,36 @@ def make_train_step(
             in_specs=(P(), batch_specs, plan_in_specs(plan)),
             out_specs=(P(), P()),
         )(params, batch, plan)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        if nonfinite_guard:
+            # one scalar decides the whole step: a single non-finite value
+            # anywhere in the grads makes the global norm non-finite, and
+            # applying such an update would poison params forever. The
+            # select is data-dependent inside the one traced program —
+            # skipped and applied steps share the executable.
+            gnorm = optax.global_norm(grads)
+            ok = jnp.isfinite(gnorm)
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            params = jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o), new_params, params
+            )
+            opt_state = jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o), new_opt_state, opt_state
+            )
+            skipped = 1.0 - ok.astype(jnp.float32)
+        else:
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
         if step_metrics:
             metrics = StepMetrics(
                 loss=metrics["loss"],
                 accuracy=metrics["accuracy"],
-                grad_norm=optax.global_norm(grads),
+                grad_norm=gnorm if nonfinite_guard else optax.global_norm(grads),
                 mask_count=metrics["mask_count"],
+                nonfinite_skipped=skipped if nonfinite_guard else None,
             )
+        elif nonfinite_guard:
+            metrics = dict(metrics, nonfinite_skipped=skipped)
         return params, opt_state, metrics
 
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
@@ -273,11 +306,20 @@ def fit(
     log_every: int = 0,
     loss_fn: Callable = masked_cross_entropy,
     batch_args: Callable = None,
+    nonfinite_guard: bool = False,
 ):
     """Convenience full-graph training driver (the ``_run_experiment`` loop,
     ``experiments/OGB/main.py:50-227``, as a function). Returns
-    (params, history)."""
+    (params, history).
+
+    This loop owns the per-epoch batch, so it is also the in-repo consumer
+    of the ``grads`` chaos point (:mod:`dgraph_tpu.chaos`): a
+    ``grads=poison@K`` clause NaN-poisons epoch K's features host-side,
+    which makes that step's gradients non-finite — pair it with
+    ``nonfinite_guard=True`` to watch the guard absorb it."""
     import numpy as np
+
+    from dgraph_tpu import chaos
 
     optimizer = optimizer or optax.adam(1e-2)
     # vmask rides along for models whose batch_args want it (harmless
@@ -291,14 +333,20 @@ def fit(
     params = init_params(model, mesh, plan, batch_tr, seed, batch_args=batch_args)
     opt_state = optimizer.init(params)
     train_step = make_train_step(
-        model, optimizer, mesh, plan, loss_fn=loss_fn, batch_args=batch_args
+        model, optimizer, mesh, plan, loss_fn=loss_fn, batch_args=batch_args,
+        nonfinite_guard=nonfinite_guard,
     )
     eval_step = make_eval_step(model, mesh, loss_fn=loss_fn, batch_args=batch_args)
 
     history = []
     with jax.set_mesh(mesh):
         for epoch in range(num_epochs):
-            params, opt_state, m = train_step(params, opt_state, batch_tr, plan)
+            bt = batch_tr
+            if chaos.fire("grads", index=epoch):
+                # host-side poison of this epoch's features only — same
+                # shapes, same executable, one step's grads go non-finite
+                bt = dict(batch_tr, x=jnp.asarray(chaos.poison_array(batch_tr["x"])))
+            params, opt_state, m = train_step(params, opt_state, bt, plan)
             rec = {"epoch": epoch, "loss": float(m["loss"]), "acc": float(m["accuracy"])}
             if log_every and epoch % log_every == 0:
                 ev = eval_step(params, batch_va, plan)

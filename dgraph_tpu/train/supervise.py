@@ -1,0 +1,248 @@
+"""Self-healing train supervisor: restart-and-resume around exit 17.
+
+``train/elastic.py`` documents the restart contract — a wedged device makes
+:class:`~dgraph_tpu.train.elastic.StepWatchdog` hard-exit the process with
+:data:`~dgraph_tpu.train.elastic.WEDGED_EXIT_CODE` (17), and "the launcher
+treats that exit as restart-and-resume" — but until this module the repo
+shipped no launcher.  ``python -m dgraph_tpu.train.supervise`` is it:
+
+- runs the training entrypoint as a subprocess;
+- restarts it on exit 17 (wedge), on crash (any nonzero exit, optional),
+  and on an attempt-level wall timeout, with exponential backoff and a
+  max-restart budget;
+- resumption is the child's job (restore ``latest_step()`` from its
+  checkpoint dir); the supervisor reads the same ``latest_step()`` before
+  each attempt so the lineage records what each attempt resumed from;
+- exports the attempt ordinal as ``DGRAPH_CHAOS_ATTEMPT`` so a chaos
+  clause (:mod:`dgraph_tpu.chaos`) can target exactly one attempt — the
+  end-to-end recovery test injects a wedge on attempt 0 and proves the
+  resumed run is bit-identical to a fault-free one;
+- emits ONE JSON-parseable lineage record on EVERY exit path (the bench
+  supervisor's discipline): attempt count, per-attempt exit codes and
+  wall times, resume steps, and a RunHealth record.
+
+The supervisor itself never touches the accelerator: reading
+``latest_step`` is a directory listing, and no jax API is called — a
+wedged lease can hang a child, never the process that must outlive it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shlex
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from dgraph_tpu.train.elastic import WEDGED_EXIT_CODE
+
+
+@dataclasses.dataclass
+class Config:
+    """Train supervisor (``--cmd "python -m ..."`` is the child entrypoint;
+    restarts on exit 17/crash with exponential backoff)."""
+
+    cmd: str = ""  # shell-style child command line (shlex-split)
+    max_restarts: int = 8  # restart budget (attempts = budget + 1)
+    backoff_s: float = 1.0  # first restart delay
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 60.0
+    restart_on_crash: bool = True  # False: only exit 17 restarts
+    attempt_timeout_s: float = 0.0  # 0 = none; kill + restart past this
+    ckpt_dir: str = ""  # lineage: record latest_step() resume points
+    log_path: str = "logs/supervise.jsonl"
+    indent: int = 0
+
+
+def _latest_step(ckpt_dir: str) -> Optional[int]:
+    """latest_step without importing the checkpoint module's orbax path at
+    module import time (it is jax-free, but keep the supervisor's import
+    surface minimal and explicit)."""
+    if not ckpt_dir:
+        return None
+    from dgraph_tpu.train.checkpoint import latest_step
+
+    return latest_step(ckpt_dir)
+
+
+def _append_jsonl(path: str, rec: dict) -> None:
+    """Plain JSONL append — ExperimentLog calls ``jax.process_index()``
+    (backend init), which the supervisor must never do."""
+    if not path:
+        return
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+
+
+def supervise(
+    argv: list,
+    *,
+    max_restarts: int = 8,
+    backoff_s: float = 1.0,
+    backoff_factor: float = 2.0,
+    backoff_max_s: float = 60.0,
+    restart_on_crash: bool = True,
+    attempt_timeout_s: float = 0.0,
+    ckpt_dir: str = "",
+    env: Optional[dict] = None,
+    _sleep=time.sleep,
+) -> dict:
+    """Run ``argv`` under restart-and-resume supervision; returns the
+    lineage record (``kind="supervise_lineage"``).
+
+    Restart policy per child exit:
+
+    - ``0``  — done; stop with success.
+    - ``17`` (:data:`WEDGED_EXIT_CODE`) — the child's watchdog declared the
+      device wedged; restart (a fresh process re-leases the backend).
+    - timeout (``attempt_timeout_s``) — the child never even reached its
+      own watchdog (init wedge); kill and restart, counted as a wedge.
+    - any other nonzero — restart when ``restart_on_crash`` else stop.
+
+    Each restart sleeps ``min(backoff_s * backoff_factor**k, backoff_max_s)``
+    first.  The child inherits the environment plus ``env`` plus
+    ``DGRAPH_CHAOS_ATTEMPT=<attempt>``.
+    """
+    from dgraph_tpu.chaos import ATTEMPT_ENV_VAR
+    from dgraph_tpu.obs.health import RunHealth
+
+    health = RunHealth.begin("train.supervisor")
+    attempts = []
+    rc: Optional[int] = None
+    gave_up = False
+    for attempt in range(max_restarts + 1):
+        if attempt:
+            delay = min(
+                backoff_s * backoff_factor ** (attempt - 1), backoff_max_s
+            )
+            _sleep(delay)
+        else:
+            delay = 0.0
+        resume_step = _latest_step(ckpt_dir)
+        child_env = {**os.environ, **(env or {}), ATTEMPT_ENV_VAR: str(attempt)}
+        t0 = time.monotonic()
+        timed_out = False
+        try:
+            rc = subprocess.run(
+                argv,
+                env=child_env,
+                timeout=attempt_timeout_s or None,
+            ).returncode
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            rc = WEDGED_EXIT_CODE  # never reached its own watchdog: a wedge
+        wall_s = time.monotonic() - t0
+        if rc == 0:
+            outcome = "ok"
+        elif timed_out:
+            outcome = "timeout"
+        elif rc == WEDGED_EXIT_CODE:
+            outcome = "wedged"
+        else:
+            outcome = "crashed"
+        attempts.append(
+            {
+                "attempt": attempt,
+                "exit_code": rc,
+                "outcome": outcome,
+                "wall_s": round(wall_s, 3),
+                "resume_step": resume_step,
+                "backoff_s": round(delay, 3),
+            }
+        )
+        health.record_probe(
+            attempt, wall_s,
+            "ok" if rc == 0 else ("hang" if outcome in ("wedged", "timeout")
+                                  else "error"),
+            f"exit {rc} ({outcome}), resumed from {resume_step}",
+        )
+        if rc == 0:
+            break
+        if outcome == "crashed" and not restart_on_crash:
+            break
+        if attempt == max_restarts:
+            gave_up = True
+    restarts = len(attempts) - 1
+    if rc == 0:
+        error, wedge = None, None
+    else:
+        last = attempts[-1]["outcome"]
+        error = (
+            f"child exited {rc} ({last}) after {restarts} restart(s)"
+            + (f"; restart budget ({max_restarts}) exhausted" if gave_up else "")
+        )
+        wedge = (
+            "watchdog_timeout" if last in ("wedged", "timeout")
+            else "stage_failure"
+        )
+    return {
+        "kind": "supervise_lineage",
+        "cmd": list(argv),
+        "attempts": attempts,
+        "restarts": restarts,
+        "final_exit_code": rc,
+        "gave_up": gave_up,
+        "final_step": _latest_step(ckpt_dir),
+        "run_health": health.finish(error, wedge),
+    }
+
+
+def main(cfg: Config) -> dict:
+    if not cfg.cmd.strip():
+        raise SystemExit(
+            'supervise: --cmd is required, e.g. --cmd "python -m '
+            'experiments.ogb_gcn --epochs 100"'
+        )
+    argv = shlex.split(cfg.cmd)
+    lineage = supervise(
+        argv,
+        max_restarts=cfg.max_restarts,
+        backoff_s=cfg.backoff_s,
+        backoff_factor=cfg.backoff_factor,
+        backoff_max_s=cfg.backoff_max_s,
+        restart_on_crash=cfg.restart_on_crash,
+        attempt_timeout_s=cfg.attempt_timeout_s,
+        ckpt_dir=cfg.ckpt_dir,
+    )
+    _append_jsonl(cfg.log_path, lineage)
+    # the lineage is ALWAYS the last stdout line, parseable on every exit
+    # path (the bench-supervisor contract pinned by tests)
+    print(json.dumps(lineage, indent=cfg.indent or None), flush=True)
+    if lineage["final_exit_code"] != 0:
+        sys.exit(lineage["final_exit_code"])
+    return lineage
+
+
+if __name__ == "__main__":
+    from dgraph_tpu.utils.cli import parse_config
+
+    try:
+        main(parse_config(Config))
+    except SystemExit:
+        raise
+    except BaseException as e:
+        # an unexpected supervisor bug must not cost the lineage JSON
+        from dgraph_tpu.obs.health import RunHealth
+
+        h = RunHealth.begin("train.supervisor")
+        print(
+            json.dumps(
+                {
+                    "kind": "supervise_lineage",
+                    "attempts": [],
+                    "restarts": 0,
+                    "final_exit_code": None,
+                    "gave_up": False,
+                    "run_health": h.finish(
+                        f"supervisor crashed: {type(e).__name__}: {e}",
+                        "stage_failure",
+                    ),
+                }
+            ),
+            flush=True,
+        )
+        sys.exit(70)

@@ -48,6 +48,12 @@ SECTIONS = [
     ("Data layer", "dgraph_tpu.data", None),
     ("Training utilities", "dgraph_tpu.train.loop", None),
     ("Elastic / failure handling", "dgraph_tpu.train.elastic", None),
+    ("Train supervisor", "dgraph_tpu.train.supervise", ["supervise"]),
+    ("Non-finite step guard", "dgraph_tpu.train.guard",
+     ["NonFiniteMonitor", "NonFiniteAbort"]),
+    ("Chaos fault injection", "dgraph_tpu.chaos",
+     ["ChaosFault", "Clause", "parse_spec", "fire", "arm", "disarm",
+      "active_spec", "poison_array", "poison_pytree"]),
     ("Checkpointing", "dgraph_tpu.train.checkpoint", None),
     ("Serving: engine", "dgraph_tpu.serve.engine", ["ServeEngine"]),
     ("Serving: shape bucketing", "dgraph_tpu.serve.bucketing",

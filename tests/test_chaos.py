@@ -1,0 +1,338 @@
+"""Chaos engineering layer (dgraph_tpu/chaos + train/supervise): spec
+grammar, deterministic firing, fault-point wiring, the self-healing train
+supervisor, and the end-to-end acceptance pin — an injected wedge at step
+k makes the child exit 17, the supervisor restarts it, the child resumes
+from the last checkpoint, and the final train state is BIT-IDENTICAL to a
+fault-free run.
+
+Everything here is compile-free (host-side state, fire-at-entry fault
+points, python -c children) — the tier-1 suite is compile-dominated and
+near its budget.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dgraph_tpu import chaos
+from dgraph_tpu.chaos import ChaosFault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_chaos_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    """Every test leaves the process on env-driven (inert) behavior."""
+    yield
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# spec grammar + firing semantics
+# ---------------------------------------------------------------------------
+
+
+def test_parse_spec_clauses():
+    cl = chaos.parse_spec(
+        "step=wedge@3:sleep_s=60:attempt=0;grads=poison@5:count=2"
+    )
+    assert len(cl) == 2
+    assert cl[0].point == "step" and cl[0].action == "wedge"
+    assert cl[0].index == 3 and cl[0].sleep_s == 60.0 and cl[0].attempt == 0
+    assert cl[1].point == "grads" and cl[1].count == 2
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "",
+        "nonsense",
+        "unknown.point=raise@0",
+        "step=explode@0",
+        "step=raise@-1",
+        "step=raise@1.5",
+        "step=raise@0:count=0",
+        "step=raise@0:prob=2.0",
+        "step=raise@0:mystery=1",
+    ],
+)
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        chaos.parse_spec(bad)
+
+
+def test_fire_is_inert_by_default():
+    chaos.disarm()
+    assert chaos.fire("step") is False
+    assert chaos.active_spec() is None
+    assert chaos.snapshot()["spec"] is None
+
+
+def test_fire_exact_call_index_and_counter():
+    chaos.arm("ckpt.save=raise@2")
+    fired = []
+    for i in range(4):
+        try:
+            chaos.fire("ckpt.save")
+        except ChaosFault as e:
+            fired.append(i)
+            assert e.point == "ckpt.save" and e.index == 2
+            assert e.record()["kind"] == "chaos_fault"
+    assert fired == [2]
+    assert chaos.call_count("ckpt.save") == 4
+
+
+def test_fire_external_index_and_count_window():
+    chaos.arm("grads=poison@5:count=2")
+    got = [s for s in range(10) if chaos.fire("grads", index=s)]
+    assert got == [5, 6]
+
+
+def test_attempt_gating():
+    # the supervisor exports the restart ordinal; a clause pinned to
+    # attempt 0 must not re-fire on the resumed attempt
+    chaos.arm("step=raise@1:attempt=0", attempt=1)
+    for s in range(4):
+        chaos.fire("step", index=s)  # no raise
+    chaos.arm("step=raise@1:attempt=1", attempt=1)
+    with pytest.raises(ChaosFault):
+        for s in range(4):
+            chaos.fire("step", index=s)
+
+
+def test_prob_schedule_deterministic():
+    def schedule():
+        chaos.arm("grads=poison@0:prob=0.5:seed=11")
+        return [s for s in range(64) if chaos.fire("grads", index=s)]
+
+    a, b = schedule(), schedule()
+    assert a == b and 0 < len(a) < 64
+
+
+def test_env_var_arming(monkeypatch):
+    monkeypatch.setenv(chaos.ENV_VAR, "grads=poison@1")
+    chaos.reset()
+    assert chaos.active_spec() == "grads=poison@1"
+    assert not chaos.fire("grads", index=0)
+    assert chaos.fire("grads", index=1)
+    monkeypatch.delenv(chaos.ENV_VAR)
+    chaos.reset()
+    assert chaos.active_spec() is None
+
+
+def test_poison_helpers():
+    x = chaos.poison_array(np.ones(4, np.float32))
+    assert np.isnan(x[0]) and np.all(x[1:] == 1.0)
+    y = chaos.poison_array(np.arange(3))  # int arrays pass through
+    assert np.array_equal(y, np.arange(3))
+    tree = chaos.poison_pytree({"x": np.ones((2, 2)), "y": np.arange(2)})
+    assert np.isnan(tree["x"][0, 0]) and tree["y"][0] == 0
+
+
+def test_unknown_point_rejected_when_armed():
+    chaos.arm("step=raise@0")
+    with pytest.raises(ValueError):
+        chaos.fire("not.a.point")
+
+
+# ---------------------------------------------------------------------------
+# fault-point wiring (fire-at-entry: no orbax/plan work needed)
+# ---------------------------------------------------------------------------
+
+
+def test_ckpt_save_point_fires(tmp_path):
+    from dgraph_tpu.train.checkpoint import save_checkpoint
+
+    chaos.arm("ckpt.save=raise@0")
+    with pytest.raises(ChaosFault):
+        save_checkpoint(str(tmp_path), {"w": np.zeros(2)}, 1)
+
+
+def test_ckpt_read_point_fires(tmp_path):
+    from dgraph_tpu.train.checkpoint import restore_checkpoint
+
+    chaos.arm("ckpt.read=raise@0")
+    with pytest.raises(ChaosFault):
+        restore_checkpoint(str(tmp_path))
+
+
+def test_data_load_point_fires():
+    from dgraph_tpu.data import DistributedGraph
+
+    chaos.arm("data.load=raise@0")
+    with pytest.raises(ChaosFault):
+        DistributedGraph.from_global(
+            np.zeros((2, 0), np.int64), np.zeros((4, 2), np.float32),
+            None, None, world_size=2,
+        )
+
+
+def test_runhealth_env_snapshot_records_spec():
+    from dgraph_tpu.obs.health import RunHealth
+
+    chaos.arm("step=raise@9")
+    assert RunHealth.begin("t").env["chaos"] == "step=raise@9"
+    chaos.disarm()
+    assert RunHealth.begin("t").env["chaos"] is None
+
+
+# ---------------------------------------------------------------------------
+# supervisor (in-process; python -c children)
+# ---------------------------------------------------------------------------
+
+
+def _pyc(code: str) -> list:
+    return [sys.executable, "-c", code]
+
+
+def test_supervisor_success_first_try():
+    from dgraph_tpu.train.supervise import supervise
+
+    lineage = supervise(_pyc("import sys; sys.exit(0)"), backoff_s=0.01)
+    assert lineage["kind"] == "supervise_lineage"
+    assert lineage["final_exit_code"] == 0 and lineage["restarts"] == 0
+    assert lineage["attempts"][0]["outcome"] == "ok"
+    assert lineage["run_health"]["wedge"] == "none"
+    json.dumps(lineage)
+
+
+def test_supervisor_restarts_on_wedge_then_succeeds():
+    from dgraph_tpu.train.supervise import supervise
+
+    code = (
+        "import os, sys; "
+        "sys.exit(17 if os.environ['DGRAPH_CHAOS_ATTEMPT'] == '0' else 0)"
+    )
+    lineage = supervise(_pyc(code), backoff_s=0.01)
+    assert lineage["final_exit_code"] == 0 and lineage["restarts"] == 1
+    assert [a["outcome"] for a in lineage["attempts"]] == ["wedged", "ok"]
+    assert lineage["attempts"][0]["exit_code"] == 17
+    # backoff applied before the restart, none before the first attempt
+    assert lineage["attempts"][0]["backoff_s"] == 0.0
+    assert lineage["attempts"][1]["backoff_s"] > 0.0
+
+
+def test_supervisor_budget_exhaustion_and_backoff_growth():
+    from dgraph_tpu.train.supervise import supervise
+
+    sleeps = []
+    lineage = supervise(
+        _pyc("import sys; sys.exit(7)"),
+        max_restarts=3, backoff_s=1.0, backoff_factor=2.0, backoff_max_s=3.0,
+        _sleep=sleeps.append,
+    )
+    assert lineage["gave_up"] and lineage["final_exit_code"] == 7
+    assert lineage["restarts"] == 3
+    assert all(a["outcome"] == "crashed" for a in lineage["attempts"])
+    # exponential, capped: 1, 2, then clamped to 3
+    assert sleeps == [1.0, 2.0, 3.0]
+    assert lineage["run_health"]["wedge"] == "stage_failure"
+    assert "restart budget" in lineage["run_health"]["error"]
+
+
+def test_supervisor_no_restart_on_crash_when_disabled():
+    from dgraph_tpu.train.supervise import supervise
+
+    lineage = supervise(
+        _pyc("import sys; sys.exit(7)"), restart_on_crash=False,
+        backoff_s=0.01,
+    )
+    assert lineage["final_exit_code"] == 7 and lineage["restarts"] == 0
+    assert not lineage["gave_up"]  # stopped by policy, not budget
+
+
+def test_supervisor_attempt_timeout_counts_as_wedge():
+    from dgraph_tpu.train.supervise import supervise
+
+    code = (
+        "import os, sys, time; "
+        "time.sleep(60 if os.environ['DGRAPH_CHAOS_ATTEMPT'] == '0' else 0)"
+    )
+    lineage = supervise(
+        _pyc(code), attempt_timeout_s=1.0, backoff_s=0.01,
+    )
+    assert [a["outcome"] for a in lineage["attempts"]] == ["timeout", "ok"]
+    assert lineage["attempts"][0]["exit_code"] == 17
+    assert lineage["final_exit_code"] == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI selftest (tier-1 registration) + end-to-end recovery
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_selftest_cli():
+    r = subprocess.run(
+        [sys.executable, "-m", "dgraph_tpu.chaos", "--selftest", "true"],
+        capture_output=True, text=True, timeout=180, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["kind"] == "chaos_selftest" and rec["failures"] == []
+    assert rec["run_health"]["wedge"] == "none"
+
+
+def _run_worker_supervised(ckpt_dir, steps, log_path, extra_env):
+    env = dict(os.environ)
+    env.pop("DGRAPH_CHAOS", None)
+    env.update(extra_env)
+    cmd = [
+        sys.executable, "-m", "dgraph_tpu.train.supervise",
+        "--cmd", f"{sys.executable} {WORKER} {ckpt_dir} {steps}",
+        "--max_restarts", "2",
+        "--backoff_s", "0.05",
+        "--ckpt_dir", str(ckpt_dir),
+        "--log_path", str(log_path),
+    ]
+    r = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=300, cwd=REPO, env=env,
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout[-1500:], r.stderr[-1500:])
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_e2e_wedge_restart_resume_bit_identical(tmp_path):
+    """THE acceptance pin: wedge injected at global step 4 on attempt 0 ->
+    watchdog exits 17 -> supervisor restarts -> worker resumes from the
+    last checkpoint -> final state bit-identical to a fault-free run."""
+    from dgraph_tpu.train.checkpoint import restore_checkpoint
+
+    steps = 6
+    # fault-free oracle run (same worker, no chaos, no restarts)
+    clean_ckpt = tmp_path / "clean"
+    lineage = _run_worker_supervised(
+        clean_ckpt, steps, tmp_path / "clean.jsonl", {},
+    )
+    assert lineage["restarts"] == 0 and lineage["final_step"] == steps
+
+    # chaotic run: wedge at step 4, first attempt only
+    chaotic_ckpt = tmp_path / "chaotic"
+    lineage = _run_worker_supervised(
+        chaotic_ckpt, steps, tmp_path / "chaotic.jsonl",
+        {"DGRAPH_CHAOS": "step=wedge@4:sleep_s=120:attempt=0"},
+    )
+    assert lineage["final_exit_code"] == 0 and lineage["restarts"] == 1
+    a0, a1 = lineage["attempts"]
+    assert a0["outcome"] == "wedged" and a0["exit_code"] == 17
+    assert a1["outcome"] == "ok"
+    # the restart resumed from the checkpoint the wedged attempt left
+    # behind (steps 0..3 completed -> checkpoint step 4)
+    assert a1["resume_step"] == 4
+    assert lineage["final_step"] == steps
+    # the artifact records the active fault spec — a chaotic run can never
+    # masquerade as a clean one
+    assert lineage["run_health"]["env"]["chaos"] == (
+        "step=wedge@4:sleep_s=120:attempt=0"
+    )
+
+    clean = restore_checkpoint(str(clean_ckpt))
+    chaotic = restore_checkpoint(str(chaotic_ckpt))
+    assert clean["step"] == chaotic["step"] == steps
+    np.testing.assert_array_equal(
+        np.asarray(clean["state"]["w"]), np.asarray(chaotic["state"]["w"])
+    )

@@ -104,3 +104,94 @@ def test_watchdog_quiet_when_beating():
         assert not fired.is_set()
     finally:
         dog.stop()
+
+
+def test_run_elastic_plumbs_first_deadline(tmp_path, monkeypatch):
+    """Callers must be able to widen the first-step compile allowance —
+    a slow trace under the default 10x multiplier is a spurious
+    wedge-restart loop."""
+    seen = {}
+    real_init = StepWatchdog.__init__
+
+    def spy_init(self, deadline_s, on_expire=None, first_deadline_s=None):
+        seen["deadline_s"] = deadline_s
+        seen["first_deadline_s"] = first_deadline_s
+        real_init(self, deadline_s, on_expire=on_expire,
+                  first_deadline_s=first_deadline_s)
+
+    monkeypatch.setattr(StepWatchdog, "__init__", spy_init)
+    run_elastic(
+        _mk_step(), {"w": jnp.zeros(2)}, start_step=0, num_steps=2,
+        ckpt_dir=str(tmp_path / "ck"), step_deadline_s=30.0,
+        first_deadline_s=123.0, guard=PreemptionGuard(signals=()),
+    )
+    assert seen == {"deadline_s": 30.0, "first_deadline_s": 123.0}
+
+
+# ---------------------------------------------------------------------------
+# non-finite guard: host monitor + abort rollback
+# ---------------------------------------------------------------------------
+
+
+def test_nonfinite_monitor_consecutive_semantics():
+    from dgraph_tpu.train.guard import NonFiniteAbort, NonFiniteMonitor
+
+    mon = NonFiniteMonitor(max_consecutive=3)
+    # a finite step resets the streak: skip, skip, ok, skip, skip never
+    # reaches 3 consecutive
+    for s, skipped in enumerate([1.0, 1.0, 0.0, 1.0, 1.0]):
+        mon.observe(skipped, step=s)
+    assert mon.total_skipped == 4 and mon.consecutive == 2
+    with pytest.raises(NonFiniteAbort) as ei:
+        mon.observe(1.0, step=5)
+    rec = ei.value.record()
+    assert rec["kind"] == "nonfinite_abort"
+    assert rec["consecutive"] == 3 and rec["step"] == 5
+    assert rec["total_skipped"] == 5
+    with pytest.raises(ValueError):
+        NonFiniteMonitor(max_consecutive=0)
+
+
+def test_run_elastic_rolls_back_on_nonfinite_abort(tmp_path):
+    from dgraph_tpu.train.guard import NonFiniteAbort
+
+    ckpt = str(tmp_path / "ck")
+    calls = {"n": 0}
+
+    def step(state):
+        calls["n"] += 1
+        if calls["n"] == 5:  # "diverged": steps 0-3 fine, step 4 aborts
+            raise NonFiniteAbort("diverged", step=4, consecutive=3,
+                                 total_skipped=3)
+        return {"w": state["w"] + 1.0}
+
+    state, last, stopped = run_elastic(
+        step, {"w": jnp.zeros(2)}, start_step=0, num_steps=10,
+        ckpt_dir=ckpt, checkpoint_every=2,
+        guard=PreemptionGuard(signals=()),
+    )
+    # rolled back to the newest checkpoint (after step 4 -> step index 4),
+    # not the poisoned in-flight state
+    assert stopped and last == 4
+    np.testing.assert_allclose(np.asarray(state["w"]), 4.0)
+    assert latest_step(ckpt) == 4
+
+
+def test_run_elastic_abort_propagates_without_checkpoint(tmp_path):
+    from dgraph_tpu.train.guard import NonFiniteAbort
+
+    def step(state):
+        raise NonFiniteAbort("diverged immediately", step=0,
+                             consecutive=3, total_skipped=3)
+
+    with pytest.raises(NonFiniteAbort):
+        run_elastic(
+            step, {"w": jnp.zeros(2)}, start_step=0, num_steps=4,
+            ckpt_dir=str(tmp_path / "empty"),  # exists-not: nothing to roll to
+            guard=PreemptionGuard(signals=()),
+        )
+    with pytest.raises(NonFiniteAbort):
+        run_elastic(
+            step, {"w": jnp.zeros(2)}, start_step=0, num_steps=4,
+            ckpt_dir=None, guard=PreemptionGuard(signals=()),
+        )

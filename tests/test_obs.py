@@ -232,7 +232,7 @@ def test_footprint_cli_prints_json(capsys):
 # ---------------------------------------------------------------------------
 
 
-def _sbm_training(step_metrics):
+def _sbm_training(step_metrics, nonfinite_guard=False):
     import jax
     import jax.numpy as jnp
     import optax
@@ -260,7 +260,8 @@ def _sbm_training(step_metrics):
     opt = optax.adam(1e-2)
     opt_state = opt.init(params)
     step = make_train_step(
-        model, opt, mesh, plan, donate=False, step_metrics=step_metrics
+        model, opt, mesh, plan, donate=False, step_metrics=step_metrics,
+        nonfinite_guard=nonfinite_guard,
     )
     return mesh, step, params, opt_state, batch, plan
 
@@ -310,6 +311,77 @@ def test_step_metrics_enabled_pipeline(mesh8, tmp_path):
     back = StepMetrics.from_record(rec)
     assert back.loss == pytest.approx(float(m.loss), rel=1e-6)
     assert back.grad_norm == pytest.approx(float(m.grad_norm), rel=1e-6)
+
+
+def test_nonfinite_guard_skips_poisoned_step_zero_recompiles(mesh8):
+    """The chaos acceptance pin for the guard: a host-poisoned (NaN) batch
+    makes that step's grads non-finite; the guard carries params/opt_state
+    forward, reports nonfinite_skipped=1, and — because the select is
+    jnp.where inside the one traced program — the jit cache does NOT grow
+    (a poisoned step replays the same executable)."""
+    import jax
+
+    from dgraph_tpu import chaos
+
+    mesh, step, params, opt_state, batch, plan = _sbm_training(
+        True, nonfinite_guard=True
+    )
+    with jax.set_mesh(mesh):
+        # reach the jit steady state (the usual one-time second compile)
+        params, opt_state, m = step(params, opt_state, batch, plan)
+        params, opt_state, m = step(params, opt_state, batch, plan)
+        assert float(m.nonfinite_skipped) == 0.0
+        warm = step._cache_size() if hasattr(step, "_cache_size") else None
+        before = jax.tree.map(np.asarray, params)
+
+        bad = dict(batch, x=jax.numpy.asarray(chaos.poison_array(batch["x"])))
+        params, opt_state, m = step(params, opt_state, bad, plan)
+        assert float(m.nonfinite_skipped) == 1.0
+        assert not np.isfinite(float(m.grad_norm))
+        # carried forward bit-for-bit: the poisoned update never landed
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+            params, before,
+        )
+        if warm is not None:
+            assert step._cache_size() == warm, "poisoned step recompiled"
+
+        # clean step afterwards applies normally again
+        params, opt_state, m = step(params, opt_state, batch, plan)
+        assert float(m.nonfinite_skipped) == 0.0
+        changed = any(
+            not np.array_equal(np.asarray(a), b)
+            for a, b in zip(
+                jax.tree.leaves(params), jax.tree.leaves(before)
+            )
+        )
+        assert changed, "clean step after a skip did not update params"
+        if warm is not None:
+            assert step._cache_size() == warm
+
+
+def test_nonfinite_guard_clean_run_matches_unguarded(mesh8):
+    """identical results on clean runs: one guarded step from the same
+    (params, opt_state, batch) produces the same params as the unguarded
+    step — the guard may only ever *select*, never perturb."""
+    import jax
+
+    mesh, step_g, params, opt_state, batch, plan = _sbm_training(
+        False, nonfinite_guard=True
+    )
+    _, step_u, _, _, _, _ = _sbm_training(False, nonfinite_guard=False)
+    with jax.set_mesh(mesh):
+        pg, og, mg = step_g(params, opt_state, batch, plan)
+        pu, ou, mu = step_u(params, opt_state, batch, plan)
+    assert set(mg.keys()) == {"loss", "accuracy", "nonfinite_skipped"}
+    assert float(mg["nonfinite_skipped"]) == 0.0
+    assert float(mg["loss"]) == pytest.approx(float(mu["loss"]), rel=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        pg, pu,
+    )
 
 
 def test_step_record_schema_roundtrip():

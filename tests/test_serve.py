@@ -368,6 +368,145 @@ def test_batcher_stop_rejects_new_submits():
         bat.submit(np.arange(2))
 
 
+def test_batcher_survives_client_cancelled_future():
+    """A client cancelling its queued Future (a normal client-side timeout
+    pattern) must be dropped like an expired request — resolving a
+    cancelled Future raises InvalidStateError, which the worker's crash
+    containment would otherwise escalate into stopping the whole batcher
+    for every other client."""
+    from dgraph_tpu.serve.batcher import MicroBatcher
+
+    block, started = threading.Event(), threading.Event()
+    eng = _FakeEngine(BucketLadder((8,)), block=block, started=started)
+    bat = MicroBatcher(
+        eng, max_batch_size=1, max_delay_ms=0.0, max_queue_depth=8
+    )
+    try:
+        f0 = bat.submit(np.arange(2))  # occupies the worker inside infer
+        assert started.wait(timeout=10)
+        f1 = bat.submit(np.arange(3))  # queued; cancel before it runs
+        f2 = bat.submit(np.arange(2))  # innocent bystander
+        assert f1.cancel()
+        block.set()
+        # the bystander is served normally — the cancelled future neither
+        # crashed the worker nor reached the engine
+        assert f2.result(timeout=10).shape == (2, 3)
+        assert f0.result(timeout=10).shape == (2, 3)
+        assert f1.cancelled()
+        assert all(c.shape[0] != 3 for c in eng.calls)
+        assert bat._worker.is_alive()
+        assert eng.registry.snapshot()["counters"][
+            "serve.rejected_cancelled"
+        ] == 1
+    finally:
+        block.set()
+        bat.stop()
+
+
+def test_batcher_worker_crash_fails_pending_and_stops():
+    """A top-level worker exception (here: a metrics callback, firing in
+    _collect AFTER requests were popped off the queue) used to kill the
+    thread silently and hang every waiter until client timeout. Now every
+    pending/in-flight future fails fast with the typed WorkerCrashed and
+    the batcher marks itself stopped."""
+    from dgraph_tpu.obs.metrics import Metrics
+    from dgraph_tpu.serve.batcher import MicroBatcher
+    from dgraph_tpu.serve.errors import EngineStopped, WorkerCrashed
+
+    class _BombRegistry(Metrics):
+        # only the worker thread's metrics path blows up; client-side
+        # submit keeps working so the request is queued normally first
+        def gauge(self, name, value):
+            if threading.current_thread().name == "serve-batcher":
+                raise RuntimeError("metrics backend down")
+            super().gauge(name, value)
+
+    eng = _FakeEngine(BucketLadder((8,)))
+    bat = MicroBatcher(eng, max_delay_ms=0.0, registry=_BombRegistry())
+    try:
+        fut = bat.submit(np.arange(3))
+        with pytest.raises(WorkerCrashed) as ei:
+            fut.result(timeout=10)
+        rec = ei.value.record()
+        assert rec["error"] == "worker_crashed"
+        json.dumps(rec)
+        bat._worker.join(timeout=10)
+        assert not bat._worker.is_alive()
+        # the crash marked the batcher stopped: immediate structured
+        # rejection, no silent queueing into a dead worker
+        with pytest.raises(EngineStopped):
+            bat.submit(np.arange(2))
+        assert eng.calls == []  # the crashed batch never reached the engine
+    finally:
+        bat.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine self-healing: bounded retry + degraded shedding (chaos-driven)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_retries_transient_device_error(serving, rng):
+    from dgraph_tpu import chaos
+
+    engine, *_ = serving
+    full = engine.full_logits()
+    try:
+        # arm() zeroes the per-point call counters: the next infer's first
+        # dispatch attempt is serve.infer index 0 and fails; the retry
+        # (index 1) succeeds
+        chaos.arm("serve.infer=raise@0")
+        ids = rng.choice(engine.num_nodes, size=5, replace=False)
+        out = engine.infer(ids)
+        r, s = engine.rank_slot(ids)
+        np.testing.assert_array_equal(out, full[r, s])
+        assert not engine.degraded
+        snap = engine.registry.snapshot()
+        assert snap["counters"]["serve.infer_retries"] >= 1
+        # a retry replays the cached executable — never a compile
+        assert engine.recompiles_since_warmup() == 0
+    finally:
+        chaos.reset()
+        engine.reset_degraded()
+
+
+def test_engine_degrades_after_repeated_failures_and_resets(serving, rng):
+    from dgraph_tpu import chaos
+
+    engine, *_ = serving
+    assert engine.degrade_after == 3 and engine.max_retries == 2
+    try:
+        chaos.arm("serve.infer=raise@0:count=1000")  # every attempt fails
+        for _ in range(engine.degrade_after):
+            with pytest.raises(chaos.ChaosFault):
+                engine.infer(rng.choice(engine.num_nodes, size=3, replace=False))
+        assert engine.degraded
+        # degraded: shed fast with the structured backpressure error, no
+        # device dispatch at all
+        with pytest.raises(QueueFull) as ei:
+            engine.infer(np.arange(3))
+        rec = ei.value.record()
+        assert rec["degraded"] is True and rec["error"] == "backpressure"
+        snap = engine.registry.snapshot()
+        assert snap["gauges"]["serve.degraded"] == 1.0
+        assert snap["counters"]["serve.shed_degraded"] >= 1
+        # the health record carries the state
+        from dgraph_tpu.serve.health import serve_health_record
+
+        assert serve_health_record(engine)["degraded"] is True
+
+        # operator re-admits; the fault is gone; traffic flows again
+        chaos.disarm()
+        engine.reset_degraded()
+        out = engine.infer(np.arange(4))
+        assert out.shape[0] == 4
+        assert serve_health_record(engine)["degraded"] is False
+        assert engine.recompiles_since_warmup() == 0
+    finally:
+        chaos.reset()
+        engine.reset_degraded()
+
+
 # ---------------------------------------------------------------------------
 # corruption tolerance: checkpoint fallback + plan-cache rebuild
 # ---------------------------------------------------------------------------
