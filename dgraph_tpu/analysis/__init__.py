@@ -1,0 +1,40 @@
+"""Static analysis: trace auditing + contract linting.
+
+Five PRs in, the repo's hardest-won invariants existed only by convention:
+the tuner auto-adopts configs priced by ``obs.footprint``'s analytic
+schedule with nothing checking that the traced program actually emits that
+schedule; PR 4 removed a mixed-lowering hazard (a config re-read inside a
+traced function could hand the forward exchange and its transpose different
+lowerings) that nothing prevented from regressing; and ``chaos`` /
+``train.supervise`` / the standalone health loader stayed jax-free only by
+hand-enforced discipline.  This package is the machine-checked backstop —
+the analogue of DGraph's layered Communicator design (each layer's contract
+checkable in isolation) and of "Memory-efficient array redistribution"
+(PAPERS.md), which treats the emitted collective schedule as a verifiable
+artifact rather than a hope:
+
+- :mod:`dgraph_tpu.analysis.trace` — the **trace auditor**: abstractly
+  traces (``jax.make_jaxpr`` / ``jax.eval_shape`` — zero XLA compiles) the
+  train step, eval step, and serve bucket forward under each halo lowering
+  and verifies the traced collective schedule against the one
+  ``obs.footprint`` priced (op counts AND operand bytes — the numbers the
+  tuner ranks on), plus single-lowering-per-program, no host callbacks,
+  fp32 accumulation, and donation consumption.
+- :mod:`dgraph_tpu.analysis.lint` — the **contract linter**: stdlib-``ast``
+  rules over the source tree (jax-free modules, no config reads in traced
+  bodies, custom_vjp pairing, named_scope on collectives, deterministic
+  plan builds), with a small registry so new contracts are one rule away.
+
+CLI::
+
+    python -m dgraph_tpu.analysis              # lint the tree + audit
+    python -m dgraph_tpu.analysis --selftest   # compile-free tier-1 smoke
+
+This module deliberately imports neither jax nor numpy at module level:
+``lint`` is pure stdlib, and ``trace`` pulls jax in lazily so the CLI can
+pin the platform/device-count env before any backend decision is made.
+"""
+
+from __future__ import annotations
+
+__all__ = ["lint", "trace"]

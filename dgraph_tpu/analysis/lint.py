@@ -1,0 +1,542 @@
+"""Contract linter: stdlib-``ast`` rules for the repo's cross-layer contracts.
+
+Each rule encodes one invariant that used to be enforced only by review
+discipline (and in two cases was already silently broken when this linter
+first ran — see the pinned regressions in ``tests/test_analysis.py``):
+
+- ``jax-free-module`` — ``dgraph_tpu.chaos``, ``train/supervise.py`` and
+  ``obs/health.py`` must never *use* jax: a wedged lease can hang any jax
+  API call, and these are exactly the modules that must outlive a wedged
+  child (the supervisor) or be loadable standalone without triggering a
+  backend (bench's health loader).  The rule flags any ``import jax`` in
+  those files (any scope) and any import of a ``dgraph_tpu`` module whose
+  own module level imports jax.  The package ``__init__`` is exempt by
+  design: normal package imports pay it, but the standalone loaders load
+  these files by path precisely to skip it, so the contract is about the
+  modules' OWN code.
+- ``no-config-read-in-trace`` — no ``dgraph_tpu.config`` attribute read or
+  ``os.environ`` access lexically inside a function that is passed to (or
+  decorated with) ``jit`` / ``shard_map`` / ``custom_vjp`` / ``grad`` /
+  ``scan`` and friends.  This is the PR 4 mixed-lowering hazard, machine
+  checked: a config read at trace time can hand two legs of one op
+  different lowerings, and a cached executable silently ignores later
+  flag flips.  Resolve once OUTSIDE the traced function and thread the
+  decision through as a static argument (``comm.collectives.
+  resolve_plan_impl`` is the pattern).
+- ``custom-vjp-paired`` — every ``jax.custom_vjp`` function must call
+  ``defvjp`` in the same file: an unpaired declaration traces fine and
+  fails only when somebody differentiates through it.
+- ``named-scope-on-collectives`` — every public function in
+  ``comm/collectives.py`` that issues a ``lax`` collective must be wrapped
+  in a named scope: un-scoped collectives are invisible in Perfetto
+  traces, and perf attribution of the halo exchange is the whole point of
+  the obs layer.
+- ``no-nondeterminism-in-plan`` — plan/partition builds must be
+  deterministic functions of (graph, seed): no unseeded RNG, no
+  wall-clock reads.  Plans are content-addressed into an on-disk cache
+  and signed by the tuner; a nondeterministic build breaks both.
+
+Suppression: append ``# lint: allow(<rule-name>)`` on the offending line
+(or the line above) — every suppression is a documented, greppable
+decision, e.g. ``obs/health.py``'s opt-in backend snapshot.
+
+Adding a rule: write ``check(path, tree, lines) -> list[Finding]``,
+decorate with :func:`rule`, and add a fixture pair to the selftest in
+``__main__.py`` (a snippet that must fire + one that must not).  Rules are
+pure stdlib (``ast`` only) so the linter runs without jax anywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Optional
+
+# functions whose function-valued arguments are traced by jax: a config
+# read inside one is a trace-time read (the PR 4 hazard class)
+TRACING_ENTRY_POINTS = frozenset({
+    "jit", "shard_map", "custom_vjp", "custom_jvp", "grad", "value_and_grad",
+    "vjp", "jvp", "linearize", "scan", "while_loop", "fori_loop", "cond",
+    "checkpoint", "remat", "pmap", "vmap", "make_jaxpr", "eval_shape",
+})
+
+# lax collectives that must appear only inside named scopes in the
+# collectives facade (named-scope-on-collectives)
+COLLECTIVE_CALLS = frozenset({
+    "all_to_all", "ppermute", "psum", "pmean", "pmax", "pmin", "all_gather",
+    "psum_scatter", "pshuffle",
+})
+
+_PRAGMA = re.compile(r"#\s*lint:\s*allow\(([a-z0-9_,\- ]+)\)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Rule:
+    name: str
+    description: str
+    applies: Callable[[str], bool]  # repo-relative posix path -> bool
+    check: Callable[[str, ast.AST, list], list]  # (relpath, tree, lines)
+
+
+RULES: dict = {}
+
+
+def rule(name: str, description: str, applies):
+    """Register a rule. ``applies`` is a predicate over the repo-relative
+    posix path (use :func:`path_matcher` for prefix/suffix sets)."""
+
+    def deco(fn):
+        RULES[name] = Rule(name, description, applies, fn)
+        return fn
+
+    return deco
+
+
+def path_matcher(*prefixes: str):
+    def match(relpath: str) -> bool:
+        return any(relpath.startswith(p) for p in prefixes)
+
+    return match
+
+
+def _suppressed(lines: list, lineno: int, rule_name: str) -> bool:
+    """True when the finding's line (or the one above) carries
+    ``# lint: allow(<rule>)`` for this rule."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines):
+            m = _PRAGMA.search(lines[ln - 1])
+            if m and rule_name in [s.strip() for s in m.group(1).split(",")]:
+                return True
+    return False
+
+
+def _dotted(node) -> str:
+    """Best-effort dotted name of an expression (``a.b.c`` -> "a.b.c")."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _last_segment(node) -> str:
+    d = _dotted(node)
+    return d.rsplit(".", 1)[-1] if d else ""
+
+
+# ---------------------------------------------------------------------------
+# jax-free-module
+# ---------------------------------------------------------------------------
+
+JAX_FREE_TARGETS = (
+    "dgraph_tpu/chaos/",
+    "dgraph_tpu/train/supervise.py",
+    "dgraph_tpu/obs/health.py",
+)
+
+
+def _module_level_imports(tree: ast.AST):
+    """(node, module) pairs for imports executed at module import time —
+    top-level statements, descending into top-level ``if``/``try`` blocks
+    (guarded imports still run at import time)."""
+    out = []
+    stack = list(getattr(tree, "body", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Import):
+            out.extend((node, a.name) for a in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0 and node.module:
+                out.append((node, node.module))
+        elif isinstance(node, (ast.If, ast.Try, ast.With)):
+            for field in ("body", "orelse", "finalbody"):
+                stack.extend(getattr(node, field, []))
+            for handler in getattr(node, "handlers", []):
+                stack.extend(handler.body)
+    return out
+
+
+def _all_imports(tree: ast.AST):
+    """(node, module, names) for every import anywhere in the file."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out.append((node, a.name, ()))
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 and node.module:
+            out.append((node, node.module, tuple(a.name for a in node.names)))
+    return out
+
+
+def _module_file(root: str, dotted: str) -> Optional[str]:
+    """Resolve a dotted module path to a file under ``root`` (or None for
+    third-party / stdlib modules)."""
+    base = os.path.join(root, *dotted.split("."))
+    for cand in (base + ".py", os.path.join(base, "__init__.py")):
+        if os.path.isfile(cand):
+            return cand
+    return None
+
+
+def _file_uses_jax_at_module_level(root: str, path: str, _seen=None) -> bool:
+    """True when importing ``path`` as a module pulls jax in, following
+    package-internal module-level imports transitively. The top-level
+    package ``__init__`` files are skipped (see module docstring)."""
+    _seen = _seen if _seen is not None else set()
+    if path in _seen:
+        return False
+    _seen.add(path)
+    try:
+        tree = ast.parse(open(path).read())
+    except (OSError, SyntaxError):
+        return False
+    for _node, mod in _module_level_imports(tree):
+        if mod == "jax" or mod.startswith("jax."):
+            return True
+        if mod.startswith("dgraph_tpu"):
+            dep = _module_file(root, mod)
+            if dep and not dep.endswith(os.path.join("dgraph_tpu", "__init__.py")):
+                if _file_uses_jax_at_module_level(root, dep, _seen):
+                    return True
+    return False
+
+
+@rule(
+    "jax-free-module",
+    "chaos/, train/supervise.py and obs/health.py must not use jax in any "
+    "scope, nor import dgraph_tpu modules that use jax at module level",
+    path_matcher(*JAX_FREE_TARGETS),
+)
+def check_jax_free(relpath: str, tree: ast.AST, lines: list, root: str = ""):
+    findings = []
+    for node, mod, names in _all_imports(tree):
+        if mod == "jax" or mod.startswith("jax."):
+            findings.append(Finding(
+                "jax-free-module", relpath, node.lineno,
+                f"import of {mod!r} in a jax-free module (a wedged lease can "
+                f"hang any jax call; this module must outlive one)",
+            ))
+            continue
+        targets = []
+        if mod.startswith("dgraph_tpu"):
+            targets.append(mod)
+            # `from dgraph_tpu.x import y` may name a submodule y
+            targets.extend(f"{mod}.{n}" for n in names)
+        for t in targets:
+            dep = _module_file(root, t) if root else None
+            if (
+                dep
+                and not dep.endswith(os.path.join("dgraph_tpu", "__init__.py"))
+                and _file_uses_jax_at_module_level(root, dep)
+            ):
+                findings.append(Finding(
+                    "jax-free-module", relpath, node.lineno,
+                    f"import of {t!r}, whose module level pulls in jax",
+                ))
+                break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# no-config-read-in-trace
+# ---------------------------------------------------------------------------
+
+
+def _config_aliases(tree: ast.AST) -> set:
+    """Names bound to the ``dgraph_tpu.config`` module anywhere in the
+    file (``from dgraph_tpu import config as _cfg``, ``import
+    dgraph_tpu.config as cfg``, ...)."""
+    aliases = set()
+    for node, mod, _names in _all_imports(tree):
+        if isinstance(node, ast.ImportFrom):
+            if mod == "dgraph_tpu":
+                for a in node.names:
+                    if a.name == "config":
+                        aliases.add(a.asname or a.name)
+        else:
+            for a in node.names:
+                if a.name == "dgraph_tpu.config" and a.asname:
+                    aliases.add(a.asname)
+    return aliases
+
+
+def _traced_functions(tree: ast.AST) -> list:
+    """Function nodes handed to jax tracing machinery: decorated with a
+    tracing entry point, or passed (by name or inline lambda) as an
+    argument to one."""
+    traced, by_name = [], {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _last_segment(target) in TRACING_ENTRY_POINTS:
+                    traced.append(node)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last_segment(node.func) not in TRACING_ENTRY_POINTS:
+            continue
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                traced.append(arg)
+            elif isinstance(arg, ast.Name):
+                traced.extend(by_name.get(arg.id, []))
+    return traced
+
+
+@rule(
+    "no-config-read-in-trace",
+    "no dgraph_tpu.config / os.environ read lexically inside a function "
+    "passed to jit/shard_map/custom_vjp/... (the PR 4 mixed-lowering "
+    "hazard: resolve before the trace, thread the decision through)",
+    path_matcher("dgraph_tpu/"),
+)
+def check_config_read_in_trace(relpath: str, tree: ast.AST, lines: list):
+    aliases = _config_aliases(tree)
+    findings = []
+    for fn in _traced_functions(tree):
+        for node in ast.walk(fn):
+            bad = None
+            if isinstance(node, ast.Attribute):
+                base = _dotted(node.value)
+                if base in aliases:
+                    bad = f"config read '{base}.{node.attr}'"
+                elif base == "os" and node.attr in ("environ", "getenv"):
+                    bad = f"environment read 'os.{node.attr}'"
+            elif isinstance(node, ast.ImportFrom) and (
+                node.module == "dgraph_tpu"
+                and any(a.name == "config" for a in node.names)
+                or node.module == "dgraph_tpu.config"
+            ):
+                bad = "dgraph_tpu.config imported"
+            elif isinstance(node, ast.Import) and any(
+                a.name == "dgraph_tpu.config" for a in node.names
+            ):
+                bad = "dgraph_tpu.config imported"
+            if bad:
+                findings.append(Finding(
+                    "no-config-read-in-trace", relpath, node.lineno,
+                    f"{bad} inside traced function "
+                    f"{getattr(fn, 'name', '<lambda>')!r} (line {fn.lineno}): "
+                    f"a trace-time read freezes into the executable and can "
+                    f"desynchronize legs of one op",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp-paired
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "custom-vjp-paired",
+    "every jax.custom_vjp declaration must have a defvjp call in the same "
+    "file (an unpaired one only fails under differentiation)",
+    path_matcher("dgraph_tpu/"),
+)
+def check_custom_vjp_paired(relpath: str, tree: ast.AST, lines: list):
+    declared = {}  # name -> lineno
+    paired = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _last_segment(target) == "custom_vjp":
+                    declared[node.name] = node.lineno
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _last_segment(node.value.func) == "custom_vjp":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        declared[t.id] = node.lineno
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "defvjp":
+                paired.add(_dotted(node.func.value))
+    return [
+        Finding(
+            "custom-vjp-paired", relpath, line,
+            f"custom_vjp function {name!r} has no defvjp call in this file",
+        )
+        for name, line in sorted(declared.items(), key=lambda kv: kv[1])
+        if name not in paired
+    ]
+
+
+# ---------------------------------------------------------------------------
+# named-scope-on-collectives
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "named-scope-on-collectives",
+    "public functions in comm/collectives.py that issue a lax collective "
+    "must be wrapped in a named scope (profiler attribution)",
+    path_matcher("dgraph_tpu/comm/collectives.py"),
+)
+def check_named_scope(relpath: str, tree: ast.AST, lines: list):
+    findings = []
+    for node in tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name.startswith("_"):
+            continue
+        issues = [
+            sub.lineno
+            for sub in ast.walk(node)
+            if isinstance(sub, ast.Call)
+            and _last_segment(sub.func) in COLLECTIVE_CALLS
+        ]
+        if not issues:
+            continue
+        scoped = any(
+            _last_segment(dec.func if isinstance(dec, ast.Call) else dec)
+            in ("named_scope", "_scoped")
+            for dec in node.decorator_list
+        )
+        if not scoped:
+            findings.append(Finding(
+                "named-scope-on-collectives", relpath, node.lineno,
+                f"public collective {node.name!r} (issues a collective at "
+                f"line {issues[0]}) is not wrapped in a named scope",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# no-nondeterminism-in-plan
+# ---------------------------------------------------------------------------
+
+SEEDED_RNG_CONSTRUCTORS = frozenset({
+    "default_rng", "Generator", "RandomState", "SeedSequence", "Random",
+    "PRNGKey", "key",
+})
+WALL_CLOCK_CALLS = frozenset({
+    "time", "time_ns", "perf_counter", "perf_counter_ns", "monotonic", "now",
+    "utcnow", "today",
+})
+
+
+@rule(
+    "no-nondeterminism-in-plan",
+    "plan/partition builds must be deterministic in (graph, seed): no "
+    "unseeded RNG and no wall-clock reads (plans are content-addressed "
+    "into the cache and signed by the tuner)",
+    path_matcher(
+        "dgraph_tpu/plan.py", "dgraph_tpu/partition.py",
+        "dgraph_tpu/tune/signature.py",
+    ),
+)
+def check_plan_determinism(relpath: str, tree: ast.AST, lines: list):
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        last = dotted.rsplit(".", 1)[-1] if dotted else ""
+        if ".random." in f".{dotted}" or dotted.startswith("random."):
+            if last in SEEDED_RNG_CONSTRUCTORS:
+                if not node.args and not node.keywords:
+                    findings.append(Finding(
+                        "no-nondeterminism-in-plan", relpath, node.lineno,
+                        f"'{dotted}()' with no seed in a plan-build path",
+                    ))
+            else:
+                findings.append(Finding(
+                    "no-nondeterminism-in-plan", relpath, node.lineno,
+                    f"unseeded module-level RNG call '{dotted}' in a "
+                    f"plan-build path (use a seeded default_rng)",
+                ))
+        elif (
+            last in WALL_CLOCK_CALLS
+            and dotted.split(".", 1)[0] in ("time", "datetime", "dt")
+        ):
+            findings.append(Finding(
+                "no-nondeterminism-in-plan", relpath, node.lineno,
+                f"wall-clock read '{dotted}' in a plan-build path",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+def repo_root() -> str:
+    """The directory containing the ``dgraph_tpu`` package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def iter_source_files(root: str):
+    pkg = os.path.join(root, "dgraph_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def lint_file(path: str, root: str, rules=None) -> list:
+    """Run every applicable rule over one file; returns unsuppressed
+    findings."""
+    relpath = os.path.relpath(path, root).replace(os.sep, "/")
+    source = open(path).read()
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("syntax", relpath, e.lineno or 0, f"unparseable: {e}")]
+    findings = []
+    for r in (rules or RULES).values():
+        if not r.applies(relpath):
+            continue
+        if r.name == "jax-free-module":
+            got = r.check(relpath, tree, lines, root=root)
+        else:
+            got = r.check(relpath, tree, lines)
+        findings.extend(
+            f for f in got if not _suppressed(lines, f.line, f.rule)
+        )
+    return findings
+
+
+def run_lint(root: Optional[str] = None, rules=None) -> dict:
+    """Lint the whole ``dgraph_tpu`` tree; returns a JSON-able report."""
+    root = root or repo_root()
+    findings, n_files = [], 0
+    for path in iter_source_files(root):
+        n_files += 1
+        findings.extend(lint_file(path, root, rules))
+    findings.sort(key=lambda f: (f.path, f.line))
+    per_rule: dict = {}
+    for f in findings:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    return {
+        "kind": "lint_report",
+        "root": root,
+        "files_checked": n_files,
+        "rules": sorted(RULES),
+        "findings": [f.to_dict() for f in findings],
+        "per_rule": per_rule,
+        "ok": not findings,
+    }

@@ -361,7 +361,16 @@ def poison_array(arr):
 
 
 def poison_pytree(tree):
-    """``poison_array`` over every float leaf of a pytree (dict batches)."""
-    import jax
+    """``poison_array`` over every float leaf of a pytree (dict batches).
 
-    return jax.tree.map(poison_array, tree)
+    Hand-rolled recursion over the container types host batches actually
+    use (dict/list/tuple) instead of ``jax.tree.map``: this module is
+    jax-free by contract (``analysis.lint``'s ``jax-free-module`` rule —
+    a wedged lease can hang any jax call, and chaos must keep firing in
+    processes that never dial a backend). Exotic pytree nodes would need
+    jax and are not host-batch material."""
+    if isinstance(tree, dict):
+        return {k: poison_pytree(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(poison_pytree(v) for v in tree)
+    return poison_array(tree)

@@ -798,6 +798,7 @@ def gather_concat(
     return jnp.concatenate([hs, hd], axis=-1)
 
 
+@_scoped("dgraph.psum_mean")
 def psum_mean(x, axis_name: Optional[str]):
     """Mean over a mesh axis (None = identity). For DP gradient sync —
     replaces the reference's DDP all-reduce (``experiments/OGB/main.py:111``)."""

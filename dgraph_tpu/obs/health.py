@@ -167,7 +167,10 @@ class RunHealth:
         backend if it isn't already — only call where device work is about
         to happen anyway. Never raises; failure is itself recorded."""
         try:
-            import jax
+            # the ONE sanctioned jax touch in this module: callers opt in
+            # to a backend dial; module import and every other path stay
+            # jax-free (bench's standalone loader depends on it)
+            import jax  # lint: allow(jax-free-module)
 
             devs = jax.devices()
             self.backend = {

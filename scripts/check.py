@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""One-shot static-analysis gate: lint + trace audit + selftest.
+
+Runs the analysis CLI in subprocesses (each pinned to the virtual-CPU
+backend — this script never dials an accelerator and works on a machine
+with no chip at all) and exits nonzero if ANY pass fails:
+
+    python scripts/check.py            # lint + audit + analysis selftest
+    python scripts/check.py --all      # also the chaos/tune/serve selftests
+
+Intended as the pre-merge gate and as the cheap first half of a bench
+round: everything here is compile-free (abstract tracing only), so a full
+run is ~30 s on a laptop CPU.  This file stays jax-free on purpose — it
+must be able to report a broken environment rather than hang in it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PASSES = [
+    ("analysis", [sys.executable, "-m", "dgraph_tpu.analysis"]),
+    ("analysis-selftest",
+     [sys.executable, "-m", "dgraph_tpu.analysis", "--selftest", "true"]),
+]
+
+EXTRA_SELFTESTS = [
+    ("chaos-selftest",
+     [sys.executable, "-m", "dgraph_tpu.chaos", "--selftest", "true"]),
+    ("tune-selftest",
+     [sys.executable, "-m", "dgraph_tpu.tune", "--selftest", "true"]),
+    ("serve-selftest",
+     [sys.executable, "-m", "dgraph_tpu.serve", "--selftest", "true"]),
+]
+
+
+def run_pass(name: str, argv: list, timeout: float) -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # hard assignment, not setdefault: every pass is a host-side static
+    # check, and an ambient JAX_PLATFORMS=tpu (a TPU VM's default) would
+    # send all of them dialing a possibly-wedged lease
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    try:
+        proc = subprocess.run(
+            argv, cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=timeout,
+        )
+        rc = proc.returncode
+        lines = (proc.stdout or "").strip().splitlines()
+        last = lines[-1] if lines else ""
+        try:
+            parsed = json.loads(last)
+        except ValueError:
+            parsed = None
+        detail = (
+            (parsed or {}).get("failures")
+            or (proc.stderr or "").strip().splitlines()[-1:]
+            if rc else None
+        )
+    except subprocess.TimeoutExpired:
+        rc, detail = 124, [f"timed out after {timeout}s"]
+    return {"pass": name, "rc": rc, "ok": rc == 0, "detail": detail}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--all", action="store_true",
+                    help="also run the chaos/tune/serve CLI selftests")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-pass timeout in seconds")
+    args = ap.parse_args()
+
+    passes = PASSES + (EXTRA_SELFTESTS if args.all else [])
+    results = []
+    for name, argv in passes:
+        print(f"[check] {name}: {' '.join(argv[1:])}", flush=True)
+        res = run_pass(name, argv, args.timeout)
+        print(f"[check] {name}: {'OK' if res['ok'] else 'FAILED'}"
+              + (f" — {res['detail']}" if not res["ok"] else ""), flush=True)
+        results.append(res)
+    ok = all(r["ok"] for r in results)
+    print(json.dumps({"kind": "check_report", "ok": ok, "passes": results}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

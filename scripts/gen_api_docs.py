@@ -82,6 +82,11 @@ SECTIONS = [
      ["measure_plan_ms"]),
     ("Autotuning: kernel-sweep winners", "dgraph_tpu.tune.adopt",
      ["pick_winners", "sweep_report"]),
+    ("Static analysis: trace auditor", "dgraph_tpu.analysis.trace",
+     ["walk_eqns", "collect_collectives", "build_audit_workload",
+      "audit_workload", "donation_unmatched", "schedule_drift_record"]),
+    ("Static analysis: contract linter", "dgraph_tpu.analysis.lint",
+     ["Finding", "Rule", "rule", "path_matcher", "lint_file", "run_lint"]),
     ("Config & flags", "dgraph_tpu.config", None),
 ]
 

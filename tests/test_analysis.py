@@ -1,0 +1,291 @@
+"""Static analysis subsystem: contract linter rules + trace auditor.
+
+Tier-1 registration is the ``python -m dgraph_tpu.analysis --selftest``
+CLI smoke (compile-free: every program is traced abstractly via
+``jax.make_jaxpr``/``jax.eval_shape``, so this file adds ZERO new XLA
+compiles to the suite — the budget rule documented in tests/README.md).
+The in-process tests pin the individual contracts, including the two
+violations the linter surfaced in the pre-analysis tree (a stray jax
+import in ``chaos.poison_pytree``, an unscoped ``psum_mean``) as fixed.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dgraph_tpu.analysis import lint as L
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# linter
+# ---------------------------------------------------------------------------
+
+
+def test_lint_tree_is_clean():
+    """The shipped tree has zero contract violations — the regression pin
+    for every violation the linter surfaced when it first ran (chaos's
+    jax-importing poison_pytree, the unscoped psum_mean collective)."""
+    report = L.run_lint()
+    assert report["ok"], report["findings"]
+    assert report["files_checked"] > 50
+    assert set(report["rules"]) == set(L.RULES)
+
+
+def _run_rule(name, path, src, root=""):
+    tree = ast.parse(src)
+    lines = src.splitlines()
+    if name == "jax-free-module":
+        got = L.RULES[name].check(path, tree, lines, root=root)
+    else:
+        got = L.RULES[name].check(path, tree, lines)
+    return [f for f in got if not L._suppressed(lines, f.line, f.rule)]
+
+
+def test_jax_free_rule_pins_the_chaos_regression():
+    """The exact shape of the pre-fix chaos.poison_pytree (a function-
+    level jax import in a jax-free module) must keep firing."""
+    src = (
+        "def poison_pytree(tree):\n"
+        "    import jax\n"
+        "    return jax.tree.map(id, tree)\n"
+    )
+    got = _run_rule("jax-free-module", "dgraph_tpu/chaos/__init__.py", src)
+    assert len(got) == 1 and got[0].line == 2
+
+
+def test_named_scope_rule_pins_the_psum_mean_regression():
+    """The exact shape of the pre-fix psum_mean (public collective with no
+    named scope) must keep firing — and the fixed spelling must not."""
+    bad = (
+        "from jax import lax\n"
+        "def psum_mean(x, axis_name):\n"
+        "    return lax.pmean(x, axis_name)\n"
+    )
+    good = (
+        "from jax import lax\n"
+        "@_scoped('dgraph.psum_mean')\n"
+        "def psum_mean(x, axis_name):\n"
+        "    return lax.pmean(x, axis_name)\n"
+    )
+    path = "dgraph_tpu/comm/collectives.py"
+    assert _run_rule("named-scope-on-collectives", path, bad)
+    assert not _run_rule("named-scope-on-collectives", path, good)
+
+
+def test_config_read_in_trace_rule():
+    """A config attribute read inside a function handed to jit/shard_map
+    fires (the PR 4 mixed-lowering hazard); the resolve-outside-and-thread
+    pattern does not."""
+    path = "dgraph_tpu/comm/collectives.py"
+    bad = (
+        "from dgraph_tpu import config as _cfg\n"
+        "import jax\n"
+        "def make(mesh):\n"
+        "    def body(x):\n"
+        "        if _cfg.halo_impl == 'ppermute':\n"
+        "            return -x\n"
+        "        return x\n"
+        "    return jax.shard_map(body, mesh=mesh)\n"
+    )
+    good = bad.replace(
+        "    def body(x):\n        if _cfg.halo_impl == 'ppermute':\n",
+        "    impl = _cfg.halo_impl\n"
+        "    def body(x):\n        if impl == 'ppermute':\n",
+    )
+    assert _run_rule("no-config-read-in-trace", path, bad)
+    assert not _run_rule("no-config-read-in-trace", path, good)
+    # os.environ inside a traced body is the same hazard
+    env_bad = (
+        "import jax, os\n"
+        "def make():\n"
+        "    return jax.jit(lambda x: x if os.environ.get('F') else -x)\n"
+    )
+    assert _run_rule("no-config-read-in-trace", path, env_bad)
+
+
+def test_custom_vjp_paired_rule():
+    path = "dgraph_tpu/ops/local.py"
+    bad = "import jax\n@jax.custom_vjp\ndef f(x):\n    return x\n"
+    assert _run_rule("custom-vjp-paired", path, bad)
+    good = bad + "f.defvjp(lambda x: (x, None), lambda r, g: (g,))\n"
+    assert not _run_rule("custom-vjp-paired", path, good)
+    # assignment spelling: g = jax.custom_vjp(fn)
+    bad2 = "import jax\ndef fn(x):\n    return x\ng = jax.custom_vjp(fn)\n"
+    assert _run_rule("custom-vjp-paired", path, bad2)
+
+
+def test_nondeterminism_rule():
+    path = "dgraph_tpu/partition.py"
+    assert _run_rule(
+        "no-nondeterminism-in-plan", path,
+        "import numpy as np\nperm = np.random.permutation(8)\n",
+    )
+    assert _run_rule(
+        "no-nondeterminism-in-plan", path,
+        "import numpy as np\nrng = np.random.default_rng()\n",
+    )
+    assert _run_rule(
+        "no-nondeterminism-in-plan", path,
+        "import time\nstamp = time.time()\n",
+    )
+    assert not _run_rule(
+        "no-nondeterminism-in-plan", path,
+        "import numpy as np\nrng = np.random.default_rng(7)\n",
+    )
+
+
+def test_pragma_suppression_requires_matching_rule():
+    src = (
+        "def f(tree):\n"
+        "    import jax  # lint: allow(jax-free-module)\n"
+    )
+    assert not _run_rule("jax-free-module", "dgraph_tpu/chaos/x.py", src)
+    wrong = src.replace("jax-free-module)", "custom-vjp-paired)")
+    assert _run_rule("jax-free-module", "dgraph_tpu/chaos/x.py", wrong)
+
+
+# ---------------------------------------------------------------------------
+# trace auditor (abstract tracing only — no compiles)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def workload2():
+    from dgraph_tpu.analysis.trace import build_audit_workload
+
+    return build_audit_workload(2)
+
+
+def test_trace_audit_2shard_pins_footprint(workload2):
+    """All three lowerings: op counts and operand bytes match what
+    obs.footprint prices (the acceptance pin at W=2; --selftest covers
+    W=4 in its own process)."""
+    from dgraph_tpu.analysis.trace import audit_workload
+
+    rep = audit_workload(workload2)
+    assert rep["ok"], rep["failures"]
+    assert rep["exchange_legs"]["train_step"] == 2 * rep["exchange_legs"][
+        "eval_step"
+    ]  # fwd+bwd vs fwd-only
+    by_impl = {(p["program"], p["impl"]): p for p in rep["programs"]}
+    n_deltas = rep["num_halo_deltas"]
+    for prog, legs in rep["exchange_legs"].items():
+        assert by_impl[(prog, "all_to_all")]["num_all_to_all"] == legs
+        assert by_impl[(prog, "ppermute")]["num_ppermute"] == legs * n_deltas
+        assert by_impl[(prog, "overlap")]["num_ppermute"] == legs * n_deltas
+    for p in rep["programs"]:
+        for op in p["collective_operands"]:
+            assert op["traced_bytes"] == op["footprint_bytes"]
+    assert rep["donation"]["unmatched"] == []
+
+
+def test_auditor_rejects_wrong_lowering_family(workload2):
+    """Vacuity guard: pin ppermute, audit as all_to_all -> must fail."""
+    from dgraph_tpu import config as cfg
+    from dgraph_tpu.analysis import trace as T
+
+    saved = (cfg.halo_impl, cfg.tuned_halo_impl)
+    try:
+        cfg.set_flags(halo_impl="ppermute", tuned_halo_impl=None)
+        fn, args = T._train_program(workload2)
+        failures = []
+        T._audit_one_program(
+            "t", "all_to_all", fn, args, workload2.plan_np, failures
+        )
+        assert failures
+    finally:
+        cfg.set_flags(halo_impl=saved[0], tuned_halo_impl=saved[1])
+
+
+def test_donation_unmatched_detects_dropped_buffers(workload2):
+    from dgraph_tpu.analysis import trace as T
+
+    fn, args = T._train_program(workload2)
+    assert T.donation_unmatched(fn, args, (workload2.params,
+                                           workload2.opt_state)) == {}
+    dropped = lambda p, o, b, pl: fn(p, o, b, pl)[2]  # metrics only
+    assert T.donation_unmatched(
+        dropped, args, (workload2.params, workload2.opt_state)
+    )
+
+
+def test_collect_collectives_counts_scalar_psums(workload2):
+    """The loss psum is a scalar — shape () must not be dropped by the
+    collector (regression: falsy-shape skip)."""
+    import jax
+
+    from dgraph_tpu.analysis import trace as T
+
+    fn, args = T._eval_program(workload2)
+    coll = T.collect_collectives(jax.make_jaxpr(fn)(*args))
+    assert coll["psum"], "eval step's loss/accuracy psums not collected"
+    assert all(r["dtype"] == "float32" for r in coll["psum"])
+
+
+def test_walk_eqns_descends_into_custom_vjp_and_pjit(workload2):
+    """The canonical traversal reaches collectives nested under
+    custom_vjp bodies (the overlap pair) — the descent the dtype-
+    discipline tests share."""
+    import jax
+
+    from dgraph_tpu import config as cfg
+    from dgraph_tpu.analysis import trace as T
+
+    saved = (cfg.halo_impl, cfg.tuned_halo_impl)
+    try:
+        cfg.set_flags(halo_impl="overlap", tuned_halo_impl=None)
+        fn, args = T._train_program(workload2)
+        coll = T.collect_collectives(jax.make_jaxpr(fn)(*args))
+        assert coll["ppermute"], (
+            "overlap rounds live inside custom_vjp bodies; the walker "
+            "must descend there"
+        )
+    finally:
+        cfg.set_flags(halo_impl=saved[0], tuned_halo_impl=saved[1])
+
+
+# ---------------------------------------------------------------------------
+# CLI selftest (tier-1: the whole subsystem on every run)
+# ---------------------------------------------------------------------------
+
+
+def test_analysis_selftest_cli(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "dgraph_tpu.analysis", "--selftest", "true",
+         "--log_path", str(tmp_path / "analysis.jsonl")],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["kind"] == "analysis_selftest"
+    assert rec["failures"] == []
+    # the acceptance pin: both shard counts audited, all lowerings ok
+    assert rec["audit"]["2"]["ok"] and rec["audit"]["4"]["ok"]
+    assert rec["audit"]["4"]["num_halo_deltas"] >= 1
+    # the JSONL stream carries the per-workload audit reports
+    rows = [
+        json.loads(ln)
+        for ln in (tmp_path / "analysis.jsonl").read_text().splitlines()
+        if ln.strip() and not ln.startswith("#")
+    ]
+    assert any(r.get("kind") == "trace_audit" for r in rows)
+    assert any(r.get("kind") == "analysis_selftest" for r in rows)
+
+
+def test_schedule_drift_record_shape():
+    """The bench-fallback record: non-null byte comparison per lowering
+    (what a wedged round attaches instead of a null metric)."""
+    from dgraph_tpu.analysis.trace import schedule_drift_record
+
+    rec = schedule_drift_record(2, num_nodes=64, num_edges=256, feat_dim=8)
+    assert rec["kind"] == "schedule_drift"
+    assert rec["drift"] is False
+    for impl in ("all_to_all", "ppermute", "overlap"):
+        row = rec["train_step_by_impl"][impl]
+        assert row["traced_bytes"] == row["footprint_bytes"] > 0

@@ -77,3 +77,44 @@ def test_smoke_run_complete_rc0():
     assert rh["child"]["wedge"] == "none"
     assert rh["supervisor"]["probes"][-1]["outcome"] == "ok"
     assert rh["supervisor"]["wedge"] == "none"
+
+
+@pytest.mark.slow
+def test_wedged_probe_window_attaches_schedule_drift():
+    """ROADMAP item 5's fallback tier: when the probe window exhausts with
+    no healthy chip, the round's JSON still carries a NON-NULL
+    schedule-drift signal (the trace auditor's footprint-vs-traced byte
+    comparison, run on the virtual-CPU backend) instead of value:null
+    alone — the BENCH_r03–r05 class of fully blind round is designed out."""
+    r = _run({
+        "JAX_PLATFORMS": "nonexistent_backend",
+        "PALLAS_AXON_POOL_IPS": "",
+        "DGRAPH_BENCH_TIMEOUT": "150",
+        "DGRAPH_BENCH_PROBE_BUDGET": "3",
+    }, timeout=240)
+    assert r.returncode == 3, (r.returncode, r.stdout, r.stderr[-500:])
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["value"] is None and "never initialized" in out["error"]
+    drift = out["schedule_drift"]
+    assert drift["kind"] == "schedule_drift", drift
+    assert "error" not in drift, drift
+    assert drift["drift"] is False
+    by_impl = drift["train_step_by_impl"]
+    for impl in ("all_to_all", "ppermute", "overlap"):
+        assert by_impl[impl]["traced_bytes"] == \
+            by_impl[impl]["footprint_bytes"] > 0
+
+
+@pytest.mark.slow
+def test_tiny_budget_skips_schedule_drift_fallback():
+    """With no budget left the fallback must be skipped, not squeezed in:
+    the wedge record's JSON still comes out on time (the original rc=3
+    contract, unchanged)."""
+    r = _run({
+        "JAX_PLATFORMS": "nonexistent_backend",
+        "PALLAS_AXON_POOL_IPS": "",
+        "DGRAPH_BENCH_TIMEOUT": "8",
+    })
+    assert r.returncode == 3
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "schedule_drift" not in out

@@ -32,19 +32,11 @@ _BARRIERS = frozenset({
 })
 
 
-def _walk_eqns(jaxpr, visit):
-    """Call ``visit(eqn)`` on every eqn, recursing into sub-jaxprs
-    (custom_vjp/custom_jvp bodies, scan, pjit, remat) — ONE traversal
-    shared by both collectors so the descent logic cannot drift."""
-    for eqn in jaxpr.eqns:
-        visit(eqn)
-        for p in eqn.params.values():
-            for item in p if isinstance(p, (list, tuple)) else [p]:
-                inner = getattr(item, "jaxpr", None)
-                if inner is not None:
-                    _walk_eqns(getattr(inner, "jaxpr", inner), visit)
-                elif hasattr(item, "eqns"):
-                    _walk_eqns(item, visit)
+# ONE canonical jaxpr traversal, shared with the trace auditor (descent
+# into custom_vjp/custom_jvp bodies, scan, pjit, remat) — the auditor's
+# collective collectors and these dtype collectors must never disagree on
+# which sub-jaxprs are reachable.
+from dgraph_tpu.analysis.trace import walk_eqns as _walk_eqns
 
 
 def _edge_sized_scatter_adds(jaxpr, e_pad, out):

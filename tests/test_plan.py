@@ -344,3 +344,103 @@ class TestHaloSortRoute:
                 )
             )
             np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestResolveHaloImplLadder:
+    """The full decision ladder of :func:`plan.resolve_halo_impl` — every
+    tier asserted via the REPORTED deciding source (env pin > adopted
+    tuning record > heuristic > plan), including the pin-without-split
+    degrade path. This is the contract ``comm.collectives``'s runtime
+    dispatch, ``obs.footprint``'s accounting, and ``plan_efficiency``'s
+    report all resolve through; if the ladder drifts, what runs, what is
+    priced, and what is reported can disagree."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_flags(self):
+        from dgraph_tpu import config as cfg
+
+        saved = (cfg.halo_impl, cfg.tuned_halo_impl)
+        yield
+        cfg.set_flags(halo_impl=saved[0], tuned_halo_impl=saved[1])
+
+    def _set(self, env="auto", record=None):
+        from dgraph_tpu import config as cfg
+
+        cfg.set_flags(halo_impl=env, tuned_halo_impl=record)
+
+    def test_env_pin_beats_record_beats_heuristic(self):
+        # heuristic alone: sparse deltas -> ppermute, dense -> all_to_all
+        self._set()
+        assert pl.resolve_halo_impl(8, (1,)) == ("ppermute", "heuristic")
+        assert pl.resolve_halo_impl(8, tuple(range(1, 8))) == (
+            "all_to_all", "heuristic")
+        # a record overrides the heuristic
+        self._set(record="all_to_all")
+        assert pl.resolve_halo_impl(8, (1,)) == ("all_to_all", "record")
+        # the env pin overrides the record — the operator's word is final
+        self._set(env="ppermute", record="all_to_all")
+        assert pl.resolve_halo_impl(8, tuple(range(1, 8))) == (
+            "ppermute", "env")
+
+    def test_no_traffic_shortcuts_every_tier(self):
+        # an empty delta set means there is nothing to choose: even an
+        # explicit env pin reports source='plan'
+        self._set(env="all_to_all", record="ppermute")
+        assert pl.resolve_halo_impl(8, ()) == ("none", "plan")
+
+    def test_overlap_legal_only_with_split(self):
+        self._set(env="overlap")
+        assert pl.resolve_halo_impl(4, (1,), overlap_available=True) == (
+            "overlap", "env")
+        self._set(record="overlap")
+        assert pl.resolve_halo_impl(4, (1,), overlap_available=True) == (
+            "overlap", "record")
+        # heuristic adopts overlap whenever the plan carries the split
+        self._set()
+        assert pl.resolve_halo_impl(4, (1, 2, 3), overlap_available=True) == (
+            "overlap", "heuristic")
+
+    def test_env_overlap_pin_without_split_degrades_to_record(self):
+        # the pinned tier is SKIPPED (never a silent wrong answer): an
+        # env 'overlap' on a split-less plan falls through to the record
+        self._set(env="overlap", record="all_to_all")
+        assert pl.resolve_halo_impl(8, (1,), overlap_available=False) == (
+            "all_to_all", "record")
+
+    def test_record_overlap_without_split_degrades_to_heuristic(self):
+        self._set(record="overlap")
+        assert pl.resolve_halo_impl(8, (1,), overlap_available=False) == (
+            "ppermute", "heuristic")
+        # both tiers pinned to overlap, no split anywhere -> heuristic
+        self._set(env="overlap", record="overlap")
+        assert pl.resolve_halo_impl(
+            8, tuple(range(1, 8)), overlap_available=False
+        ) == ("all_to_all", "heuristic")
+
+    def test_degrade_warns_once_per_source(self, caplog):
+        import logging
+
+        pl._overlap_warned.clear()
+        self._set(env="overlap")
+        with caplog.at_level(logging.WARNING, logger=pl._logger.name):
+            pl.resolve_halo_impl(8, (1,), overlap_available=False)
+            pl.resolve_halo_impl(8, (1,), overlap_available=False)
+        warns = [r for r in caplog.records if "overlap" in r.getMessage()]
+        assert len(warns) == 1, "degrade warning must fire once per source"
+        pl._overlap_warned.clear()
+
+    def test_reported_source_reaches_plan_efficiency(self):
+        """The deciding source is not just returned — it lands in the
+        plan_efficiency report (the operator-facing surface)."""
+        plan, layout = pl.build_edge_plan(EDGES, PART, world_size=2)
+        self._set(env="all_to_all")
+        eff = pl.plan_efficiency(plan, layout)
+        assert (eff["halo_impl"], eff["halo_impl_source"]) == (
+            "all_to_all", "env")
+        self._set(env="auto", record="ppermute")
+        eff = pl.plan_efficiency(plan, layout)
+        assert (eff["halo_impl"], eff["halo_impl_source"]) == (
+            "ppermute", "record")
+        self._set()
+        eff = pl.plan_efficiency(plan, layout)
+        assert eff["halo_impl_source"] == "heuristic"
