@@ -54,30 +54,49 @@ def log(msg: str) -> None:
 
 _HEALTH_MOD = None
 _HEALTH = None  # this process's RunHealth (child or supervisor)
+_SPANS_MOD = None
+
+
+def _load_standalone(name: str, *relpath: str):
+    """Load a repo module by PATH without importing the dgraph_tpu
+    package: the package __init__ imports jax, and the supervisor must
+    never do that (a wedged lease hangs backend init inside a GIL-holding
+    C call — the exact failure this harness exists to survive)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), *relpath
+    )
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    # register BEFORE exec: dataclass field-type resolution looks the
+    # module up in sys.modules while the class is being built
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _health_mod():
-    """Load obs/health.py WITHOUT importing the dgraph_tpu package: the
-    package __init__ imports jax, and the supervisor must never do that
-    (a wedged lease hangs backend init inside a GIL-holding C call — the
-    exact failure this harness exists to survive). health.py itself is
-    dependency-free."""
+    """obs/health.py, standalone (it is dependency-free by contract)."""
     global _HEALTH_MOD
     if _HEALTH_MOD is None:
-        import importlib.util
-
-        path = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            "dgraph_tpu", "obs", "health.py",
+        _HEALTH_MOD = _load_standalone(
+            "_dgraph_obs_health", "dgraph_tpu", "obs", "health.py"
         )
-        spec = importlib.util.spec_from_file_location("_dgraph_obs_health", path)
-        mod = importlib.util.module_from_spec(spec)
-        # register BEFORE exec: dataclass field-type resolution looks the
-        # module up in sys.modules while the class is being built
-        sys.modules["_dgraph_obs_health"] = mod
-        spec.loader.exec_module(mod)
-        _HEALTH_MOD = mod
     return _HEALTH_MOD
+
+
+def _spans_mod():
+    """obs/spans.py, standalone (stdlib-only by the same lint-enforced
+    contract): per-probe/per-stage spans from the supervisor and child,
+    no-ops unless DGRAPH_TRACE=1. health.py's trace_id lookup finds this
+    twin via sys.modules under the name registered here."""
+    global _SPANS_MOD
+    if _SPANS_MOD is None:
+        _SPANS_MOD = _load_standalone(
+            "_dgraph_obs_spans", "dgraph_tpu", "obs", "spans.py"
+        )
+    return _SPANS_MOD
 
 
 def _make_runner(scan_fn):
@@ -811,8 +830,10 @@ def _child_main():
     if cfg.use_pallas_gather is True:
         cfg.set_flags(use_pallas_gather=pallas_gather_selfcheck())
 
+    sp = _spans_mod()  # stage spans join the supervisor's trace when on
     try:
-        dt_ms, roof = bench_gcn(dtype_name)
+        with sp.span("bench.gcn", dtype=dtype_name):
+            dt_ms, roof = bench_gcn(dtype_name)
     except Exception as e:  # emit JSON, never a bare traceback
         _emit_json_and_exit(f"gcn stage failed: {type(e).__name__}: {e}",
                             EXIT_EMPTY, wedge="stage_failure")
@@ -853,7 +874,8 @@ def _child_main():
         failed_levels = []
         for gc_level in ladder:
             try:
-                gc_ms, gc_info = bench_graphcast(dtype_name, level=gc_level)
+                with sp.span("bench.graphcast", level=gc_level):
+                    gc_ms, gc_info = bench_graphcast(dtype_name, level=gc_level)
                 if failed_levels:
                     # PJRT's peak counter is cumulative with no reset, so
                     # after a bigger level OOM'd the reading is THAT
@@ -915,19 +937,19 @@ def _supervisor_emit(state: dict, error: str, wedge=None) -> int:
     return rc
 
 
-def _schedule_drift_fallback(budget_s: float):
-    """No healthy chip this round — land a non-null schedule-drift signal
-    instead of a bare null (ROADMAP item 5's fallback tier): the trace
-    auditor's footprint-vs-traced byte comparison, run on the virtual-CPU
-    backend in a throwaway subprocess (compile-free, ~10 s), attached to
-    the round's JSON as ``schedule_drift``.  A wedged lease can hide a
-    lowering regression for several rounds; this keeps the comm-schedule
-    dimension observable with zero chip involvement.  Returns None when
-    the remaining budget is too small or the fallback is disabled
-    (``DGRAPH_BENCH_ANALYSIS_FALLBACK=0``)."""
+def _analysis_fallback(kind: str, module: str, budget_s: float,
+                       min_budget_s: float = 30.0):
+    """The ONE budget-bounded subprocess helper behind every wedged-path
+    analysis fallback (``schedule_drift`` and ``cpu_scan_delta`` share it
+    — two ad-hoc spawns would fork the env-pinning/parse/disable logic).
+    Runs ``python -m <module> --bench_fallback true`` on the virtual-CPU
+    backend and returns the last JSON line whose ``kind`` matches.
+    Returns None when the remaining budget is under ``min_budget_s`` or
+    the fallbacks are disabled (``DGRAPH_BENCH_ANALYSIS_FALLBACK=0``
+    turns BOTH tiers off uniformly)."""
     if os.environ.get("DGRAPH_BENCH_ANALYSIS_FALLBACK", "1") == "0":
         return None
-    if budget_s < 30:
+    if budget_s < min_budget_s:
         return None
     import subprocess
 
@@ -939,8 +961,7 @@ def _schedule_drift_fallback(budget_s: float):
         env["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
-    argv = [sys.executable, "-m", "dgraph_tpu.analysis",
-            "--bench_fallback", "true"]
+    argv = [sys.executable, "-m", module, "--bench_fallback", "true"]
     try:
         p = subprocess.run(
             argv, capture_output=True, text=True, env=env,
@@ -952,15 +973,33 @@ def _schedule_drift_fallback(budget_s: float):
                 rec = json.loads(line)
             except ValueError:
                 continue
-            if rec.get("kind") == "schedule_drift":
+            if rec.get("kind") == kind:
                 rec.pop("run_health", None)  # the bench JSON carries its own
                 return rec
         tail = (p.stderr or "").strip().splitlines()
-        return {"kind": "schedule_drift", "error":
+        return {"kind": kind, "error":
                 f"no record (rc={p.returncode}): {tail[-1] if tail else '?'}"}
     except Exception as e:  # the fallback must never cost the round's JSON
-        return {"kind": "schedule_drift",
-                "error": f"{type(e).__name__}: {e}"}
+        return {"kind": kind, "error": f"{type(e).__name__}: {e}"}
+
+
+def _attach_fallbacks(state: dict, remaining_s) -> dict:
+    """Attach every non-null analysis tier the remaining budget allows:
+    ``schedule_drift`` (trace auditor, compile-free, ROADMAP item 5 tier
+    1) then ``cpu_scan_delta`` (compile-inside-scan per-phase step-time
+    attribution per halo lowering, tier 2 — the piece that makes a wedged
+    round's perf trajectory non-null, obs.attribution). ``remaining_s``
+    is a callable so the second tier sees what the first actually left."""
+    drift = _analysis_fallback(
+        "schedule_drift", "dgraph_tpu.analysis", remaining_s())
+    if drift is not None:
+        state["schedule_drift"] = drift
+    delta = _analysis_fallback(
+        "cpu_scan_delta", "dgraph_tpu.obs.attribution", remaining_s(),
+        min_budget_s=45.0)
+    if delta is not None:
+        state["cpu_scan_delta"] = delta
+    return state
 
 
 def main() -> int:
@@ -1059,10 +1098,15 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
              f"import jax, jax.numpy as jnp; {pin}jax.devices(); "
              f"{check}; float(jnp.ones((8, 128)).sum())"]
     phase1_end = min(phase1_start + probe_budget, deadline - 0.5 * budget)
+    # per-probe spans (obs.spans, loaded standalone like health): no-ops
+    # unless DGRAPH_TRACE=1, in which case the probe history, the child's
+    # stage spans, and the RunHealth records share one trace id
+    sp = _spans_mod()
     attempt = 0
     while True:
         attempt += 1
         t_probe = time.time()
+        probe_span = sp.span("bench.probe", attempt=attempt)
         try:
             pp = subprocess.Popen(probe, stdout=subprocess.DEVNULL,
                                   stderr=subprocess.PIPE, text=True)
@@ -1072,6 +1116,7 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
             if pp.returncode == 0:
                 log(f"backend probe OK (attempt {attempt})")
                 _HEALTH.record_probe(attempt, time.time() - t_probe, "ok")
+                probe_span.end(outcome="ok")
                 break
             tail = (perr or "").strip().splitlines()
             log(f"backend probe attempt {attempt} rc={pp.returncode}: "
@@ -1079,6 +1124,7 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
             _HEALTH.record_probe(
                 attempt, time.time() - t_probe, "error",
                 f"rc={pp.returncode}: {tail[-1] if tail else '?'}")
+            probe_span.end(error=f"rc={pp.returncode}", outcome="error")
         except subprocess.TimeoutExpired:
             pp.kill()
             pp.communicate()
@@ -1086,6 +1132,7 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
             _HEALTH.record_probe(
                 attempt, time.time() - t_probe, "hang",
                 "probe hung (wedged lease)")
+            probe_span.end(error="probe hung (wedged lease)", outcome="hang")
         finally:
             child_proc[0] = None
         if time.time() >= phase1_end:
@@ -1093,12 +1140,11 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
             # a small total budget can cap the probe phase shorter than
             # the default, and the wedge record must say what happened.
             # With the chip unreachable, spend a slice of the remaining
-            # budget landing the analysis fallback's schedule-drift signal
-            # so the round's artifact is non-null (ROADMAP item 5)
-            state = {}
-            drift = _schedule_drift_fallback(deadline - time.time() - 20)
-            if drift is not None:
-                state["schedule_drift"] = drift
+            # budget landing the analysis fallbacks (schedule drift +
+            # cpu scan-delta timing) so the round's artifact is non-null
+            # (ROADMAP item 5)
+            state = _attach_fallbacks(
+                {}, lambda: deadline - time.time() - 20)
             return _supervisor_emit(
                 state, f"backend never initialized within {attempt} probes "
                        f"(~{int(phase1_end - phase1_start)}s probe window); "
@@ -1116,9 +1162,12 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
     spawn = 0
     while True:
         spawn += 1
+        child_span = sp.span("bench.child", spawn=spawn)
         env = dict(os.environ)
         env["DGRAPH_BENCH_CHILD"] = "1"
         env["DGRAPH_BENCH_STATE"] = state_path
+        # the child's stage spans join this trace (no-op when tracing off)
+        env.update(sp.child_env(parent=child_span))
         child_budget = max(60, int(deadline - time.time()) - 30)
         env["DGRAPH_BENCH_TIMEOUT"] = str(child_budget)
         p = subprocess.Popen(
@@ -1128,19 +1177,19 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
         child_proc[0] = p
         try:
             stdout, _ = p.communicate(timeout=child_budget + 60)
+            child_span.end(rc=p.returncode)
         except subprocess.TimeoutExpired:
             p.kill()
             p.communicate()
+            child_span.end(error="hung past its watchdog; killed")
             state = read_state()
             if not state.get("value"):
                 # the chip wedged before the primary metric landed: attach
-                # the CPU-side schedule-drift signal IF budget remains —
-                # a hung child has usually consumed the deadline already,
-                # and overrunning it here risks an outer hard-kill eating
-                # the round's JSON line (the one unbreakable contract)
-                drift = _schedule_drift_fallback(deadline - time.time() - 20)
-                if drift is not None:
-                    state["schedule_drift"] = drift
+                # the CPU-side analysis tiers IF budget remains — a hung
+                # child has usually consumed the deadline already, and
+                # overrunning it here risks an outer hard-kill eating the
+                # round's JSON line (the one unbreakable contract)
+                _attach_fallbacks(state, lambda: deadline - time.time() - 20)
             return _supervisor_emit(
                 state,
                 "bench child hung past its own watchdog; killed",

@@ -102,6 +102,25 @@ _FIXTURES = {
             "    return jax.jit(body)(x)\n"
         ),
     },
+    "no-span-in-trace": {
+        "path": "dgraph_tpu/train/loop.py",
+        "bad": (
+            "import jax\n"
+            "from dgraph_tpu.obs import spans\n"
+            "def step(x):\n"
+            "    def body(y):\n"
+            "        with spans.span('inner', stage='agg'):\n"
+            "            return y * 2\n"
+            "    return jax.jit(body)(x)\n"
+        ),
+        "good": (
+            "import jax\n"
+            "from dgraph_tpu.obs import spans\n"
+            "def step(x):\n"
+            "    with spans.span('outer', stage='step'):\n"
+            "        return jax.jit(lambda y: y * 2)(x)\n"
+        ),
+    },
     "custom-vjp-paired": {
         "path": "dgraph_tpu/ops/local.py",
         "bad": (

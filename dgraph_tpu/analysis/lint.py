@@ -150,6 +150,9 @@ JAX_FREE_TARGETS = (
     "dgraph_tpu/chaos/",
     "dgraph_tpu/train/supervise.py",
     "dgraph_tpu/obs/health.py",
+    # the span tracer is imported by the supervisor and loaded standalone
+    # by bench's wedge-surviving loader — same contract as health.py
+    "dgraph_tpu/obs/spans.py",
 )
 
 
@@ -339,6 +342,61 @@ def check_config_read_in_trace(relpath: str, tree: ast.AST, lines: list):
                     f"{getattr(fn, 'name', '<lambda>')!r} (line {fn.lineno}): "
                     f"a trace-time read freezes into the executable and can "
                     f"desynchronize legs of one op",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# no-span-in-trace
+# ---------------------------------------------------------------------------
+
+# host-side span/timer entry points (obs.spans / utils.timing) that must
+# never execute inside a traced body: a host clock read there measures
+# TRACING (once), not execution (every step), and a span id would freeze
+# into the cached executable — both silently wrong, never crashing
+SPAN_CALLS = frozenset({"span", "start_span"})
+TIMER_CALLS = frozenset({"start", "stop", "time", "add_time"})
+PROFILER_CALLS = frozenset({"trace_to"})
+
+
+@rule(
+    "no-span-in-trace",
+    "no obs.spans span / TimingReport timer / profiler call lexically "
+    "inside a function passed to jit/shard_map/scan/... (host timing in a "
+    "traced body measures tracing, not execution; spans stay at host "
+    "boundaries)",
+    path_matcher("dgraph_tpu/"),
+)
+def check_span_in_trace(relpath: str, tree: ast.AST, lines: list):
+    findings = []
+    for fn in _traced_functions(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            last = _last_segment(node.func)
+            bad = None
+            if last in SPAN_CALLS:
+                # only span-shaped calls: a string name argument or
+                # keyword attrs (filters regex Match.span(int) lookalikes)
+                named = any(
+                    isinstance(a, ast.Constant) and isinstance(a.value, str)
+                    for a in node.args
+                ) or bool(node.keywords)
+                if named:
+                    bad = f"span call '{dotted or last}'"
+            elif dotted.startswith("TimingReport.") and last in TIMER_CALLS:
+                bad = f"host timer call '{dotted}'"
+            elif last in PROFILER_CALLS:
+                bad = f"profiler context '{dotted or last}'"
+            if bad:
+                findings.append(Finding(
+                    "no-span-in-trace", relpath, node.lineno,
+                    f"{bad} inside traced function "
+                    f"{getattr(fn, 'name', '<lambda>')!r} (line {fn.lineno}):"
+                    f" host-side timing inside a jit/shard_map/scan body "
+                    f"runs at trace time, not per step — move it outside "
+                    f"the traced boundary",
                 ))
     return findings
 

@@ -16,8 +16,21 @@ Three pillars, one import:
   state, wedge classification, topology snapshot) bench.py and the
   experiment CLIs embed in their artifacts, so a null benchmark is
   diagnosable from the JSON alone.
+- :mod:`dgraph_tpu.obs.spans` — the flight recorder: hierarchical
+  host-side spans with trace/span/parent ids shared across train, serve,
+  and bench (and across process restarts), JSONL records, and a Perfetto
+  (Chrome trace) exporter. One attribute read when disabled; never inside
+  traced code (lint-enforced).
+- :mod:`dgraph_tpu.obs.attribution` — CPU scan-delta step-time
+  attribution: per-phase ``{interior, exchange, optimizer, other}``
+  timing per halo lowering on the virtual-CPU backend — bench.py's
+  non-null timing tier for wedged rounds.
 """
 
+# spans is deliberately NOT imported here: `python -m dgraph_tpu.obs.spans`
+# (the perfetto-export/selftest CLI) would otherwise execute the module
+# twice — once via this package import, once as __main__ — leaving two
+# default tracers in one process. Use `from dgraph_tpu.obs import spans`.
 from dgraph_tpu.obs.footprint import plan_footprint
 from dgraph_tpu.obs.health import RunHealth, classify_wedge, startup_record
 from dgraph_tpu.obs.metrics import Metrics, StepMetrics, default_registry

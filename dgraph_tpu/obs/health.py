@@ -84,6 +84,27 @@ def classify_wedge(error: Optional[str], probes: Optional[list] = None) -> str:
     return "unknown"
 
 
+def _active_trace_id() -> Optional[str]:
+    """The ambient span-trace id (:mod:`dgraph_tpu.obs.spans`), so health
+    records are joinable against span/step JSONL across a restart chain.
+    Looked up via sys.modules — never imported — for the same reason as
+    the chaos field: bench's supervisor loads this file standalone (by
+    path, registering the spans twin as ``_dgraph_obs_spans``), and that
+    load must never trigger the package ``__init__``'s jax import. The
+    env var is the fallback for children that inherit a trace without
+    ever importing the tracer."""
+    import sys
+
+    for name in ("dgraph_tpu.obs.spans", "_dgraph_obs_spans"):
+        mod = sys.modules.get(name)
+        if mod is not None:
+            try:
+                return mod.current_trace_id()
+            except Exception:  # diagnostics must never break the run
+                return None
+    return os.environ.get("DGRAPH_TRACE_ID") or None
+
+
 def _host_snapshot() -> dict:
     import platform
     import socket
@@ -136,6 +157,10 @@ class RunHealth:
     wedge: str = "none"
     error: Optional[str] = None
     wall_s: Optional[float] = None
+    # the active span-trace id (obs.spans) when tracing is on — the join
+    # key against supervise_lineage / span / step JSONL; None otherwise.
+    # Additive to schema 1 (readers ignore unknown fields).
+    trace_id: Optional[str] = None
     schema: int = SCHEMA_VERSION
     _t0: float = dataclasses.field(default=0.0, repr=False)
 
@@ -146,6 +171,7 @@ class RunHealth:
             started_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             host=_host_snapshot(),
             env=_env_snapshot(),
+            trace_id=_active_trace_id(),
             _t0=time.perf_counter(),
         )
 

@@ -36,6 +36,7 @@ from typing import Optional
 
 import numpy as np
 
+from dgraph_tpu.obs import spans
 from dgraph_tpu.obs.metrics import Metrics
 from dgraph_tpu.serve.errors import (
     EngineStopped,
@@ -52,6 +53,13 @@ class _Pending:
     future: Future
     enqueued_at: float  # time.monotonic()
     deadline: float
+    # the request's span (obs.spans; the shared no-op when tracing is
+    # off), started at submit on the client thread and ended wherever the
+    # request resolves — worker flush, rejection, crash, or stop. One span
+    # covers the whole enqueue -> batch-form -> pad -> infer -> reply
+    # lifecycle, so the trace id survives every rejection path.
+    span: object = spans.NOOP_SPAN
+    popped_at: float = 0.0  # when the worker pulled it off the queue
 
 
 class MicroBatcher:
@@ -112,6 +120,11 @@ class MicroBatcher:
         ids = np.asarray(node_ids)
         if ids.ndim != 1:
             raise ValueError(f"node_ids must be 1-D, got shape {ids.shape}")
+        # the per-request span opens at submit (client thread) and follows
+        # the request across the worker thread; rejection paths end it
+        # with the structured error code, so the trace id survives
+        # QueueFull/too-large/stopped exactly like a served request
+        req_span = spans.span("serve.request", n=int(ids.shape[0]))
         # full request validation up front: an impossible request must not
         # occupy a queue slot, and — because the worker CONCATENATES
         # requests — must never reach the engine, where its failure would
@@ -120,11 +133,13 @@ class MicroBatcher:
             self.engine.ladder.bucket_for(ids.shape[0])
         except RequestTooLarge:
             self.registry.counter("serve.rejected_too_large")
+            req_span.end(error="too_large")
             raise
         num_nodes = getattr(self.engine, "num_nodes", None)
         if num_nodes is not None and ids.size and (
             ids.min() < 0 or ids.max() >= num_nodes
         ):
+            req_span.end(error="bad_ids")
             raise ValueError(
                 f"node ids must be in [0, {num_nodes}), got "
                 f"[{ids.min()}, {ids.max()}]"
@@ -133,9 +148,11 @@ class MicroBatcher:
         timeout_s = self.default_timeout_s if timeout_s is None else float(timeout_s)
         with self._cv:
             if self._stopped:
+                req_span.end(error="stopped")
                 raise EngineStopped("batcher is stopped")
             if len(self._q) >= self.max_queue_depth:
                 self.registry.counter("serve.rejected_backpressure")
+                req_span.end(error="backpressure")
                 raise QueueFull(
                     f"queue at capacity ({self.max_queue_depth} requests "
                     "waiting); retry with backoff",
@@ -143,7 +160,9 @@ class MicroBatcher:
                     max_queue_depth=self.max_queue_depth,
                 )
             fut: Future = Future()
-            self._q.append(_Pending(ids, fut, now, now + timeout_s))
+            self._q.append(
+                _Pending(ids, fut, now, now + timeout_s, span=req_span)
+            )
             self.registry.gauge("serve.queue_depth", float(len(self._q)))
             self._cv.notify()
         return fut
@@ -186,10 +205,12 @@ class MicroBatcher:
                 self._fail_future(
                     p.future, EngineStopped("batcher stopped mid-flight")
                 )
+                p.span.end(error="stopped mid-flight")
         with self._cv:
             while self._q:
                 p = self._q.popleft()
                 self._fail_future(p.future, EngineStopped("batcher stopped"))
+                p.span.end(error="stopped")
 
     # --- worker side ---
 
@@ -222,6 +243,7 @@ class MicroBatcher:
             self._cv.notify_all()
         for p in pending:
             self._fail_future(p.future, err)
+            p.span.end(error="worker_crashed")
         # best-effort observability: the registry itself may be what crashed
         try:
             self.registry.counter("serve.worker_crashed")
@@ -254,11 +276,14 @@ class MicroBatcher:
             batch = self._inflight = []
             total = 0
             cap = self.engine.ladder.max_size
+            popped_at = time.monotonic()
             while self._q and len(batch) < self.max_batch_size:
                 nxt = self._q[0]
                 if batch and total + nxt.ids.shape[0] > cap:
                     break  # would overflow the largest bucket; next batch
-                batch.append(self._q.popleft())
+                p = self._q.popleft()
+                p.popped_at = popped_at  # queue-wait ends here
+                batch.append(p)
                 total += nxt.ids.shape[0]
             self.registry.gauge("serve.queue_depth", float(len(self._q)))
             return batch
@@ -276,6 +301,7 @@ class MicroBatcher:
             # closing the race where cancel() lands after this check.
             if not p.future.set_running_or_notify_cancel():
                 self.registry.counter("serve.rejected_cancelled")
+                p.span.end(error="cancelled")
                 continue
             if now > p.deadline:
                 self.registry.counter("serve.rejected_timeout")
@@ -287,25 +313,58 @@ class MicroBatcher:
                         waited_s=round(now - p.enqueued_at, 4),
                     )
                 )
+                p.span.end(error="timeout",
+                           queue_wait_ms=round((now - p.enqueued_at) * 1e3, 3))
             else:
                 live.append(p)
         if not live:
             return  # expired/cancelled-only batch: flush empty, no engine call
+        # per-request stage times: queue_wait (enqueue -> worker pop) and
+        # batch_form (pop -> flush start); pad/infer come back from the
+        # engine as batch-level numbers and reply is the fan-out below
+        for p in live:
+            popped = p.popped_at or now
+            self.registry.histogram(
+                "serve.stage.queue_wait_ms", (popped - p.enqueued_at) * 1e3
+            )
+            self.registry.histogram(
+                "serve.stage.batch_form_ms", max(now - popped, 0.0) * 1e3
+            )
         ids = np.concatenate([p.ids for p in live]) if len(live) > 1 else live[0].ids
         try:
-            out = self.engine.infer(ids)
+            # the batch span is the worker thread's ambient span, so the
+            # engine's serve.infer span parents under it
+            with spans.span("serve.batch", requests=len(live),
+                            n=int(ids.shape[0])):
+                out = self.engine.infer(ids)
         except Exception as e:  # noqa: BLE001 — fan the failure to every waiter
+            err_label = f"{type(e).__name__}: {e}"
             for p in live:
                 p.future.set_exception(e)
+                p.span.end(error=err_label[:200])
             return
+        stage = getattr(self.engine, "last_stage_ms", {})
         off = 0
-        done = time.monotonic()
+        reply_t0 = time.monotonic()
         for p in live:
             n = p.ids.shape[0]
             p.future.set_result(out[off : off + n])
             off += n
+        done = time.monotonic()
+        reply_ms = (done - reply_t0) * 1e3
+        self.registry.histogram("serve.stage.reply_ms", reply_ms)
+        for p in live:
+            popped = p.popped_at or now
             self.registry.histogram(
                 "serve.request_ms", (done - p.enqueued_at) * 1e3
+            )
+            p.span.end(
+                queue_wait_ms=round((popped - p.enqueued_at) * 1e3, 3),
+                batch_form_ms=round(max(now - popped, 0.0) * 1e3, 3),
+                pad_ms=round(stage.get("pad", 0.0), 3),
+                infer_ms=round(stage.get("infer", 0.0), 3),
+                reply_ms=round(reply_ms, 3),
+                batch_size=len(live),
             )
         self.registry.counter("serve.batches")
         self.registry.histogram("serve.requests_per_batch", float(len(live)))

@@ -32,6 +32,7 @@ from jax.sharding import PartitionSpec as P
 
 from dgraph_tpu import compat as _compat  # noqa: F401  (jax.shard_map on 0.4.x)
 from dgraph_tpu.comm.mesh import GRAPH_AXIS, plan_in_specs, squeeze_plan
+from dgraph_tpu.obs import spans
 from dgraph_tpu.obs.metrics import Metrics, default_registry
 from dgraph_tpu.serve.bucketing import BucketLadder, pad_ids
 from dgraph_tpu.train.loop import model_apply
@@ -215,24 +216,42 @@ class ServeEngine:
                 f"node ids must be in [0, {self.num_nodes}), got "
                 f"[{ids.min()}, {ids.max()}]"
             )
+        # span parent = the batcher's ambient batch span when called from
+        # the worker thread (contextvar), a root otherwise; one attr read
+        # when tracing is off. The SAME span covers every retry, so the
+        # trace id survives the retry/degraded paths.
+        sp = spans.span("serve.infer", n=int(ids.shape[0]))
         if self.degraded:
             self.registry.counter("serve.shed_degraded")
+            sp.end(error="backpressure: degraded shed")
             raise QueueFull(
                 "engine degraded after repeated device failures; shedding "
                 "load (reset_degraded() to re-admit)",
                 degraded=True,
                 consecutive_failures=self._consecutive_failures,
             )
-        bucket = self.ladder.bucket_for(ids.shape[0])
-        padded, n = pad_ids(ids, bucket)
         t0 = time.perf_counter()
+        try:
+            bucket = self.ladder.bucket_for(ids.shape[0])
+        except ServeError as e:  # RequestTooLarge: structured, never queued
+            sp.end(error=e.code)
+            raise
+        padded, n = pad_ids(ids, bucket)
+        # pad stage: bucket pick + id padding + the FIRST index-operand
+        # build (rebuilds inside the retry loop are failure-path cost and
+        # stay inside the infer stage)
+        rank_idx = jnp.asarray(self._id_rank[padded])
+        slot_idx = jnp.asarray(self._id_slot[padded])
+        pad_ms = (time.perf_counter() - t0) * 1e3
+        t_infer = time.perf_counter()
         last_err = None
         for attempt in range(self.max_retries + 1):
-            # index operands are rebuilt per attempt: they are DONATED to
-            # the executable, and a dispatch that failed midway may already
-            # have invalidated them
-            rank_idx = jnp.asarray(self._id_rank[padded])
-            slot_idx = jnp.asarray(self._id_slot[padded])
+            if attempt:
+                # index operands are rebuilt per retry: they are DONATED to
+                # the executable, and a dispatch that failed midway may
+                # already have invalidated them
+                rank_idx = jnp.asarray(self._id_rank[padded])
+                slot_idx = jnp.asarray(self._id_slot[padded])
             try:
                 chaos.fire("serve.infer")
                 with jax.set_mesh(self.mesh):
@@ -243,6 +262,7 @@ class ServeEngine:
                 out = np.asarray(jax.block_until_ready(out))[:n]
                 break
             except ServeError:  # structured rejections are never transient
+                sp.end(error="serve_error", attempts=attempt + 1)
                 raise
             except Exception as e:  # noqa: BLE001 — transient device error
                 last_err = e
@@ -261,13 +281,25 @@ class ServeEngine:
                     f"failures (last: {type(last_err).__name__}: {last_err})",
                     flush=True,
                 )
+            sp.end(
+                error=f"{type(last_err).__name__}: {last_err}",
+                attempts=self.max_retries + 1,
+            )
             raise last_err
         self._consecutive_failures = 0
+        infer_ms = (time.perf_counter() - t_infer) * 1e3
+        # per-stage timings for the batcher's request spans + health
+        # quantiles (worker-thread single-writer; read right after infer)
+        self.last_stage_ms = {"pad": pad_ms, "infer": infer_ms}
+        sp.end(bucket=int(bucket), pad_ms=round(pad_ms, 3),
+               infer_ms=round(infer_ms, 3))
         if _record:
             dt_ms = (time.perf_counter() - t0) * 1e3
             reg = self.registry
             reg.counter("serve.infer_calls")
             reg.histogram("serve.infer_ms", dt_ms)
+            reg.histogram("serve.stage.pad_ms", pad_ms)
+            reg.histogram("serve.stage.infer_ms", infer_ms)
             reg.histogram("serve.batch_occupancy", n / bucket)
             reg.gauge(
                 "serve.recompiles_since_warmup",

@@ -22,6 +22,11 @@ from dgraph_tpu.obs.metrics import Metrics
 # otherwise)
 _LATENCY_HISTOGRAMS = ("serve.request_ms", "serve.infer_ms")
 
+# per-stage request-lifecycle histograms (obs.spans instrumentation in the
+# batcher/engine), folded into the record as p50/p95/p99 snapshots so
+# "where did the latency go" is answerable from the artifact alone
+_STAGES = ("queue_wait", "batch_form", "pad", "infer", "reply")
+
 
 def serve_health_record(
     engine, batcher=None, *, registry: Optional[Metrics] = None
@@ -37,6 +42,11 @@ def serve_health_record(
         if hist and hist.get("count"):
             latency = {"source": name, **hist}
             break
+    stages = {}
+    for stage in _STAGES:
+        hist = snap["histograms"].get(f"serve.stage.{stage}_ms")
+        if hist and hist.get("count"):
+            stages[stage] = hist
     rec = {
         "kind": "serve_health",
         **h.finish(),
@@ -52,6 +62,9 @@ def serve_health_record(
         # were produced under, or None for the hard-coded defaults
         "tuning_record": getattr(engine, "tuning_record_id", None),
         "latency_ms": latency,
+        # per-stage breakdown (count/mean/p50/p95/p99 each): queue-wait vs
+        # batch-form vs bucket-pad vs infer vs reply
+        "stages_ms": stages,
         "metrics": snap,
     }
     if batcher is not None:

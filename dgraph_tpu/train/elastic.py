@@ -32,6 +32,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+import dgraph_tpu.obs.spans as spans  # stdlib-only module (lint-enforced)
+
 WEDGED_EXIT_CODE = 17  # distinct exit for "device wedged, restart+resume me"
 
 
@@ -225,19 +227,31 @@ def run_elastic(
         # a long orbax write is not a wedged device — pause the watchdog
         nonlocal last_saved
         with (dog.suspended() if dog is not None else contextlib.nullcontext()):
-            save_checkpoint(ckpt_dir, {"state": st, "step": n}, n)
+            with spans.span("train.checkpoint", parent=run_span, step=n):
+                save_checkpoint(ckpt_dir, {"state": st, "step": n}, n)
         last_saved = n
 
+    # one span per attempt-run, one per step (both no-ops when tracing is
+    # off — a single attribute read each). Under train.supervise the
+    # inherited trace env roots these under the supervisor's attempt span,
+    # which is what makes restart chains one joinable timeline.
+    run_span = spans.span(
+        "train.run", start_step=start_step, num_steps=num_steps,
+        attempt=os.environ.get("DGRAPH_CHAOS_ATTEMPT"),
+    )
     try:
         for step in range(start_step, num_steps):
             # fault injection lands HERE, at the host step boundary: a
             # 'wedge' holds the loop exactly like a hung dispatch (only the
             # watchdog can catch it), 'sigterm' exercises the preemption
             # poll below, 'raise' the supervisor's crash-restart path
-            chaos.fire("step", index=step)
+            step_span = spans.span("train.step", parent=run_span, step=step)
             try:
+                chaos.fire("step", index=step)
                 state = train_step(state)
+                step_span.end()
             except NonFiniteAbort as e:
+                step_span.end(error="nonfinite_abort")
                 restored = (
                     _rollback(ckpt_dir, state, dog)
                     if rollback_on_abort and ckpt_dir else None
@@ -253,6 +267,12 @@ def run_elastic(
                     flush=True,
                 )
                 return restored[0], restored[1], True
+            except BaseException as e:
+                # a crashing step must still land its span record — this
+                # is exactly the step the flight recorder needs to show
+                # (the supervisor only sees "attempt crashed")
+                step_span.end(error=f"{type(e).__name__}: {e}")
+                raise
             if dog is not None:
                 dog.beat()
             done_now = guard.should_stop()
@@ -268,6 +288,7 @@ def run_elastic(
             if ckpt_dir and is_lead and last_saved != num_steps:
                 _save(state, num_steps)
     finally:
+        run_span.end(last_step=step, preempted=preempted)
         if dog is not None:
             dog.stop()
         if own_guard:

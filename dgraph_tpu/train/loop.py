@@ -320,6 +320,7 @@ def fit(
     import numpy as np
 
     from dgraph_tpu import chaos
+    from dgraph_tpu.obs import spans
 
     optimizer = optimizer or optax.adam(1e-2)
     # vmask rides along for models whose batch_args want it (harmless
@@ -346,7 +347,10 @@ def fit(
                 # host-side poison of this epoch's features only — same
                 # shapes, same executable, one step's grads go non-finite
                 bt = dict(batch_tr, x=jnp.asarray(chaos.poison_array(batch_tr["x"])))
-            params, opt_state, m = train_step(params, opt_state, bt, plan)
+            # host-boundary span (never inside the jitted step): one attr
+            # read when tracing is off
+            with spans.span("train.epoch", epoch=epoch):
+                params, opt_state, m = train_step(params, opt_state, bt, plan)
             rec = {"epoch": epoch, "loss": float(m["loss"]), "acc": float(m["accuracy"])}
             if log_every and epoch % log_every == 0:
                 ev = eval_step(params, batch_va, plan)

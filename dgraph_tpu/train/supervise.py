@@ -37,6 +37,7 @@ import sys
 import time
 from typing import Optional
 
+import dgraph_tpu.obs.spans as spans  # jax-free (lint-enforced)
 from dgraph_tpu.train.elastic import WEDGED_EXIT_CODE
 
 
@@ -110,6 +111,11 @@ def supervise(
     from dgraph_tpu.chaos import ATTEMPT_ENV_VAR
     from dgraph_tpu.obs.health import RunHealth
 
+    # ONE trace per supervised run, one span per attempt: the restart
+    # chain becomes a single timeline, and the children join it via the
+    # exported trace env (obs.spans.child_env) — so their step metrics and
+    # health records are joinable against this lineage by trace_id.
+    run_span = spans.span("train.supervise", cmd=" ".join(argv))
     health = RunHealth.begin("train.supervisor")
     attempts = []
     rc: Optional[int] = None
@@ -123,7 +129,14 @@ def supervise(
         else:
             delay = 0.0
         resume_step = _latest_step(ckpt_dir)
-        child_env = {**os.environ, **(env or {}), ATTEMPT_ENV_VAR: str(attempt)}
+        attempt_span = spans.span(
+            "supervise.attempt", parent=run_span,
+            attempt=attempt, resume_step=resume_step,
+        )
+        child_env = {
+            **os.environ, **(env or {}), ATTEMPT_ENV_VAR: str(attempt),
+            **spans.child_env(parent=attempt_span),
+        }
         t0 = time.monotonic()
         timed_out = False
         try:
@@ -144,6 +157,10 @@ def supervise(
             outcome = "wedged"
         else:
             outcome = "crashed"
+        attempt_span.end(
+            error=None if rc == 0 else f"exit {rc} ({outcome})",
+            exit_code=rc, outcome=outcome,
+        )
         attempts.append(
             {
                 "attempt": attempt,
@@ -152,6 +169,8 @@ def supervise(
                 "wall_s": round(wall_s, 3),
                 "resume_step": resume_step,
                 "backoff_s": round(delay, 3),
+                # joinable against the span JSONL (None when tracing off)
+                "span_id": attempt_span.span_id,
             }
         )
         health.record_probe(
@@ -179,9 +198,13 @@ def supervise(
             "watchdog_timeout" if last in ("wedged", "timeout")
             else "stage_failure"
         )
+    run_span.end(error=error, restarts=restarts, final_exit_code=rc)
     return {
         "kind": "supervise_lineage",
         "cmd": list(argv),
+        # the join key: every attempt span, child health record, and child
+        # step-metrics line carries this id when tracing is on
+        "trace_id": spans.current_trace_id(),
         "attempts": attempts,
         "restarts": restarts,
         "final_exit_code": rc,

@@ -50,22 +50,47 @@ class Config:
     max_request: int = 128
     seed: int = 0
     log_path: str = "logs/serve_bench.jsonl"
+    # per-request span export (obs.spans): one kind="span" line per
+    # request in the JSONL, sharing one trace id with the report — the
+    # raw material for the queue-wait/infer/pad breakdown below. Off =
+    # zero tracing overhead (the disabled one-attr-read path).
+    trace: bool = True
 
 
 def main(cfg: Config) -> dict:
-    import numpy as np
-
-    from dgraph_tpu.obs.health import startup_record
-    from dgraph_tpu.serve.__main__ import Config as ServeConfig, build_serving
-    from dgraph_tpu.serve.errors import ServeError
-    from dgraph_tpu.serve.health import serve_health_record
     from dgraph_tpu.utils import ExperimentLog
 
     if cfg.max_request > cfg.max_bucket:
         raise SystemExit(
             f"max_request {cfg.max_request} exceeds max_bucket {cfg.max_bucket}"
         )
+    from dgraph_tpu.obs import spans
+
     log = ExperimentLog(cfg.log_path, echo=False)
+    trace_id, enabled_here = None, False
+    if cfg.trace and not spans.enabled():
+        # per-request spans ride the same JSONL as the report (ExperimentLog
+        # is a valid sink), under one trace id the report carries
+        trace_id, enabled_here = spans.enable(sink=log), True
+    elif spans.enabled():
+        trace_id = spans.current_trace_id()
+    try:
+        report = _run(cfg, log, trace_id)
+    finally:
+        if enabled_here:
+            spans.disable()  # don't leak an enabled global tracer to callers
+    print(json.dumps(report))
+    return report
+
+
+def _run(cfg: Config, log, trace_id) -> dict:
+    import numpy as np
+
+    from dgraph_tpu.obs.health import startup_record
+    from dgraph_tpu.serve.__main__ import Config as ServeConfig, build_serving
+    from dgraph_tpu.serve.errors import ServeError
+    from dgraph_tpu.serve.health import _STAGES, serve_health_record
+
     log.write(startup_record("experiments.serve_bench"))
 
     serve_cfg = ServeConfig(
@@ -122,6 +147,16 @@ def main(cfg: Config) -> dict:
     snap = engine.registry.snapshot()
     lat = snap["histograms"].get("serve.request_ms", {"count": 0})
     occ = snap["histograms"].get("serve.batch_occupancy", {})
+    # queue-wait vs infer vs pad-overhead breakdown (the per-stage
+    # histograms the span instrumentation feeds): groundwork for the
+    # p99-under-contention artifact — contention shows up as queue_wait
+    # p99 growth while infer p99 stays flat
+    q = ("count", "mean", "p50", "p95", "p99", "max")
+    stages = {}
+    for stage in _STAGES:
+        hist = snap["histograms"].get(f"serve.stage.{stage}_ms")
+        if hist and hist.get("count"):
+            stages[stage] = {k: hist.get(k) for k in q}
     completed = sum(ok)
     report = {
         "kind": "serve_bench",
@@ -138,6 +173,8 @@ def main(cfg: Config) -> dict:
         "latency_ms": {
             k: lat.get(k) for k in ("count", "mean", "p50", "p95", "p99", "max")
         },
+        "stages_ms": stages,
+        "trace_id": trace_id,
         "batch_occupancy_mean": occ.get("mean"),
         "recompiles_since_warmup": engine.recompiles_since_warmup(),
         "buckets": [int(b) for b in engine.ladder.sizes],
@@ -148,7 +185,6 @@ def main(cfg: Config) -> dict:
     }
     log.write(report)
     log.write(serve_health_record(engine, batcher))
-    print(json.dumps(report))
     return report
 
 

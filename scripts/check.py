@@ -28,6 +28,8 @@ PASSES = [
     ("analysis", [sys.executable, "-m", "dgraph_tpu.analysis"]),
     ("analysis-selftest",
      [sys.executable, "-m", "dgraph_tpu.analysis", "--selftest", "true"]),
+    ("spans-selftest",
+     [sys.executable, "-m", "dgraph_tpu.obs.spans", "--selftest", "true"]),
 ]
 
 EXTRA_SELFTESTS = [

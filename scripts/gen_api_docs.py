@@ -71,6 +71,12 @@ SECTIONS = [
      ["StepMetrics", "Metrics", "step_record"]),
     ("Observability: run health", "dgraph_tpu.obs.health",
      ["RunHealth", "classify_wedge", "startup_record"]),
+    ("Observability: span tracing", "dgraph_tpu.obs.spans",
+     ["Tracer", "Span", "span", "enable", "disable", "enabled",
+      "current_span", "current_trace_id", "child_env", "read_spans",
+      "export_perfetto"]),
+    ("Observability: step-time attribution", "dgraph_tpu.obs.attribution",
+     ["scan_delta_attribution", "multichip_family_table"]),
     ("Autotuning: signatures", "dgraph_tpu.tune.signature",
      ["graph_signature", "signature_key", "degree_histogram"]),
     ("Autotuning: records & adoption", "dgraph_tpu.tune.record",

@@ -80,18 +80,20 @@ def test_smoke_run_complete_rc0():
 
 
 @pytest.mark.slow
-def test_wedged_probe_window_attaches_schedule_drift():
-    """ROADMAP item 5's fallback tier: when the probe window exhausts with
-    no healthy chip, the round's JSON still carries a NON-NULL
-    schedule-drift signal (the trace auditor's footprint-vs-traced byte
-    comparison, run on the virtual-CPU backend) instead of value:null
-    alone — the BENCH_r03–r05 class of fully blind round is designed out."""
+def test_wedged_probe_window_attaches_fallback_tiers():
+    """ROADMAP item 5's fallback tiers: when the probe window exhausts
+    with no healthy chip, the round's JSON carries BOTH non-null analysis
+    signals — ``schedule_drift`` (trace auditor, footprint-vs-traced
+    bytes) and ``cpu_scan_delta`` (per-phase step-time attribution per
+    halo lowering, obs.attribution) — instead of value:null alone. The
+    BENCH_r03–r05 class of fully blind round is designed out: even a
+    wedged round lands comparable timing numbers, labeled by tier."""
     r = _run({
         "JAX_PLATFORMS": "nonexistent_backend",
         "PALLAS_AXON_POOL_IPS": "",
-        "DGRAPH_BENCH_TIMEOUT": "150",
+        "DGRAPH_BENCH_TIMEOUT": "420",
         "DGRAPH_BENCH_PROBE_BUDGET": "3",
-    }, timeout=240)
+    }, timeout=540)
     assert r.returncode == 3, (r.returncode, r.stdout, r.stderr[-500:])
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["value"] is None and "never initialized" in out["error"]
@@ -103,13 +105,27 @@ def test_wedged_probe_window_attaches_schedule_drift():
     for impl in ("all_to_all", "ppermute", "overlap"):
         assert by_impl[impl]["traced_bytes"] == \
             by_impl[impl]["footprint_bytes"] > 0
+    # tier 2: per-phase cpu_scan_delta timing for (at least) the
+    # all_to_all and overlap lowerings, labeled by tier, schema-stable
+    delta = out["cpu_scan_delta"]
+    assert delta["kind"] == "cpu_scan_delta", delta
+    assert "error" not in delta, delta
+    assert delta["tier"] == "cpu_scan_delta" and delta["schema"] == 1
+    assert delta["backend"] == "cpu"
+    for impl in ("all_to_all", "overlap"):
+        by = delta["by_impl"][impl]
+        assert by["full_ms"] is not None and by["full_ms"] > 0, (impl, by)
+        assert set(by["phases_ms"]) == {
+            "interior", "exchange", "optimizer", "other"
+        }
+        assert by["phases_ms"]["exchange"] is not None, (impl, by)
 
 
 @pytest.mark.slow
-def test_tiny_budget_skips_schedule_drift_fallback():
-    """With no budget left the fallback must be skipped, not squeezed in:
-    the wedge record's JSON still comes out on time (the original rc=3
-    contract, unchanged)."""
+def test_tiny_budget_skips_analysis_fallbacks():
+    """With no budget left BOTH fallbacks must be skipped, not squeezed
+    in: the wedge record's JSON still comes out on time (the original
+    rc=3 contract, unchanged)."""
     r = _run({
         "JAX_PLATFORMS": "nonexistent_backend",
         "PALLAS_AXON_POOL_IPS": "",
@@ -118,3 +134,21 @@ def test_tiny_budget_skips_schedule_drift_fallback():
     assert r.returncode == 3
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert "schedule_drift" not in out
+    assert "cpu_scan_delta" not in out
+
+
+@pytest.mark.slow
+def test_analysis_fallback_env_disables_both_tiers():
+    """DGRAPH_BENCH_ANALYSIS_FALLBACK=0 turns the shared subprocess
+    helper off uniformly — neither tier may spawn."""
+    r = _run({
+        "JAX_PLATFORMS": "nonexistent_backend",
+        "PALLAS_AXON_POOL_IPS": "",
+        "DGRAPH_BENCH_TIMEOUT": "150",
+        "DGRAPH_BENCH_PROBE_BUDGET": "3",
+        "DGRAPH_BENCH_ANALYSIS_FALLBACK": "0",
+    }, timeout=120)
+    assert r.returncode == 3
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "schedule_drift" not in out
+    assert "cpu_scan_delta" not in out
