@@ -55,6 +55,7 @@ def log(msg: str) -> None:
 _HEALTH_MOD = None
 _HEALTH = None  # this process's RunHealth (child or supervisor)
 _SPANS_MOD = None
+_SUPERVISE_MOD = None
 
 
 def _load_standalone(name: str, *relpath: str):
@@ -97,6 +98,23 @@ def _spans_mod():
             "_dgraph_obs_spans", "dgraph_tpu", "obs", "spans.py"
         )
     return _SPANS_MOD
+
+
+def _supervise_mod():
+    """train/supervise.py, standalone (jax-free by the same lint-enforced
+    contract): the backend-probe loop runs under the SAME restart/backoff/
+    wall-budget policy as the train supervisor, so a wedged lease produces
+    a ``supervise_lineage`` record instead of a hand-rolled retry loop's
+    free text (ROADMAP item 5). The spans/health twins must be registered
+    first — supervise.py detects them in sys.modules."""
+    global _SUPERVISE_MOD
+    if _SUPERVISE_MOD is None:
+        _spans_mod()
+        _health_mod()
+        _SUPERVISE_MOD = _load_standalone(
+            "_dgraph_train_supervise", "dgraph_tpu", "train", "supervise.py"
+        )
+    return _SUPERVISE_MOD
 
 
 def _make_runner(scan_fn):
@@ -1093,63 +1111,113 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
            "os.environ.get('JAX_PLATFORMS') or 'cpu'); ")
     # the probe must run a real device op + scalar fetch, not just
     # init: a wedged lease can init PJRT fine and hang the first
-    # dispatch (the established wedge probe from r1+r2)
+    # dispatch (the established wedge probe from r1+r2). dup2 folds the
+    # probe's stdout into its stderr (the bench contract is ONE JSON
+    # line on OUR stdout), which supervise captures to a per-attempt
+    # file. On failure the probe writes its own error line to a sidecar
+    # so the round's JSON says WHY the backend failed (ImportError vs
+    # PJRT init vs device lost); a native-code death (segfault / PJRT
+    # abort) never reaches that handler, so the captured stderr tail is
+    # the fallback — the wedge record must be diagnosable alone (the
+    # BENCH_r05 lesson).
+    err_path = state_path + ".probe_err"
     probe = [sys.executable, "-c",
-             f"import jax, jax.numpy as jnp; {pin}jax.devices(); "
-             f"{check}; float(jnp.ones((8, 128)).sum())"]
+             f"import os; os.dup2(2, 1)\n"
+             f"try:\n"
+             f"    import jax, jax.numpy as jnp; {pin}jax.devices(); "
+             f"{check}; float(jnp.ones((8, 128)).sum())\n"
+             f"except BaseException as e:\n"
+             f"    open({err_path!r}, 'w').write("
+             f"f'{{type(e).__name__}}: {{e}}')\n"
+             f"    raise\n"]
     phase1_end = min(phase1_start + probe_budget, deadline - 0.5 * budget)
-    # per-probe spans (obs.spans, loaded standalone like health): no-ops
-    # unless DGRAPH_TRACE=1, in which case the probe history, the child's
-    # stage spans, and the RunHealth records share one trace id
-    sp = _spans_mod()
-    attempt = 0
-    while True:
-        attempt += 1
-        t_probe = time.time()
-        probe_span = sp.span("bench.probe", attempt=attempt)
+    # The probe loop runs UNDER train.supervise (loaded standalone like
+    # health/spans — this process still never imports jax): restart on
+    # failure with capped exponential backoff, each attempt's timeout
+    # clamped to the remaining window, and --probe-budget-s as the
+    # overall fail-fast wall budget. A wedged lease therefore produces a
+    # structured supervise_lineage (every attempt's outcome/wall/rc) in
+    # the round's JSON — plus both analysis fallback tiers below —
+    # instead of a hung probe (ROADMAP item 5). The attempt spans
+    # (supervise.attempt) join this trace when DGRAPH_TRACE=1, as
+    # bench.probe spans did before.
+    sup = _supervise_mod()
+    sp = _spans_mod()  # phase-2 child spans join the same trace
+
+    def _on_spawn(p):
+        try:  # a stale tail from the previous attempt must not mislabel
+            os.unlink(err_path)
+        except OSError:
+            pass
+        child_proc[0] = p
+
+    stderr_path = state_path + ".probe_stderr"
+
+    def _record_probe(rec):
+        status = ("ok" if rec["outcome"] == "ok"
+                  else "hang" if rec["outcome"] in ("wedged", "timeout")
+                  else "error")
+        note = ""
+        if status != "ok":
+            tail = []
+            try:
+                with open(err_path) as fh:
+                    tail = fh.read().strip().splitlines()
+            except OSError:
+                pass  # timeout/kill before the probe could write its tail
+            if not tail:
+                # native-code death (segfault / PJRT abort) never runs
+                # the probe's except handler — the captured stderr tail
+                # is the only diagnostic left
+                try:
+                    with open(stderr_path, errors="replace") as fh:
+                        tail = fh.read().strip().splitlines()
+                except OSError:
+                    pass
+            note = f": {tail[-1][-300:]}" if tail else ""
+            note = f"exit {rec['exit_code']} ({rec['outcome']})" + note
+        # operator-facing ordinals are 1-based, matching the RunHealth
+        # probes[] record (the lineage JSON keeps supervise's 0-based
+        # attempt index — the DGRAPH_CHAOS_ATTEMPT contract)
+        log(f"backend probe attempt {rec['attempt'] + 1}: {rec['outcome']} "
+            f"(rc={rec['exit_code']}, {rec['wall_s']:.1f}s)"
+            + (f" {note}" if note else ""))
+        _HEALTH.record_probe(rec["attempt"] + 1, rec["wall_s"], status, note)
+
+    lineage = sup.supervise(
+        probe,
+        max_restarts=999,  # the wall budget is the binding limit
+        backoff_s=5.0, backoff_factor=2.0, backoff_max_s=45.0,
+        attempt_timeout_s=150.0,
+        budget_s=max(1.0, phase1_end - time.time()),
+        stderr_path=stderr_path,
+        on_spawn=_on_spawn,
+        on_attempt=_record_probe,
+    )
+    child_proc[0] = None
+    for p in (err_path, stderr_path):
         try:
-            pp = subprocess.Popen(probe, stdout=subprocess.DEVNULL,
-                                  stderr=subprocess.PIPE, text=True)
-            child_proc[0] = pp
-            _, perr = pp.communicate(
-                timeout=min(150, max(5, phase1_end - time.time())))
-            if pp.returncode == 0:
-                log(f"backend probe OK (attempt {attempt})")
-                _HEALTH.record_probe(attempt, time.time() - t_probe, "ok")
-                probe_span.end(outcome="ok")
-                break
-            tail = (perr or "").strip().splitlines()
-            log(f"backend probe attempt {attempt} rc={pp.returncode}: "
-                f"{tail[-1] if tail else '?'}")
-            _HEALTH.record_probe(
-                attempt, time.time() - t_probe, "error",
-                f"rc={pp.returncode}: {tail[-1] if tail else '?'}")
-            probe_span.end(error=f"rc={pp.returncode}", outcome="error")
-        except subprocess.TimeoutExpired:
-            pp.kill()
-            pp.communicate()
-            log(f"backend probe attempt {attempt} hung (wedged lease)")
-            _HEALTH.record_probe(
-                attempt, time.time() - t_probe, "hang",
-                "probe hung (wedged lease)")
-            probe_span.end(error="probe hung (wedged lease)", outcome="hang")
-        finally:
-            child_proc[0] = None
-        if time.time() >= phase1_end:
-            # report the window actually probed, not the configured knob —
-            # a small total budget can cap the probe phase shorter than
-            # the default, and the wedge record must say what happened.
-            # With the chip unreachable, spend a slice of the remaining
-            # budget landing the analysis fallbacks (schedule drift +
-            # cpu scan-delta timing) so the round's artifact is non-null
-            # (ROADMAP item 5)
-            state = _attach_fallbacks(
-                {}, lambda: deadline - time.time() - 20)
-            return _supervisor_emit(
-                state, f"backend never initialized within {attempt} probes "
-                       f"(~{int(phase1_end - phase1_start)}s probe window); "
-                       f"wedged TPU lease")
-        time.sleep(min(45, max(5, phase1_end - time.time())))
+            os.unlink(p)
+        except OSError:
+            pass
+    if lineage["final_exit_code"] != 0:
+        # report the window actually probed, not the configured knob —
+        # a small total budget can cap the probe phase shorter than
+        # the default, and the wedge record must say what happened.
+        # With the chip unreachable, spend a slice of the remaining
+        # budget landing the analysis fallbacks (schedule drift +
+        # cpu scan-delta timing) so the round's artifact is non-null
+        # (ROADMAP item 5)
+        state = _attach_fallbacks(
+            {"supervise_lineage": lineage},
+            lambda: deadline - time.time() - 20)
+        return _supervisor_emit(
+            state,
+            f"backend never initialized within {len(lineage['attempts'])} "
+            f"probes (~{int(time.time() - phase1_start)}s probe window); "
+            f"wedged TPU lease")
+    log(f"backend probe OK "
+        f"(attempt {lineage['attempts'][-1]['attempt'] + 1})")
 
     # Phase 2: the real bench, with the remaining budget minus a margin
     # so the child's own watchdog fires first (richer JSON than ours).

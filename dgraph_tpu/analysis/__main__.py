@@ -151,6 +151,21 @@ _FIXTURES = {
             "    return lax.all_to_all(x, axis, 0, 0)\n"
         ),
     },
+    "no-monolithic-plan-pickle": {
+        "path": "dgraph_tpu/train/checkpoint.py",
+        "bad": (
+            "from dgraph_tpu.train.checkpoint import atomic_pickle_dump\n"
+            "def cache(path, edge_index, part):\n"
+            "    from dgraph_tpu.plan import build_edge_plan\n"
+            "    plan = build_edge_plan(edge_index, part)\n"
+            "    atomic_pickle_dump(path, plan)\n"
+        ),
+        "good": (
+            "from dgraph_tpu.train.checkpoint import atomic_pickle_dump\n"
+            "def save(path, step, params):\n"
+            "    atomic_pickle_dump(path, {'step': step, 'params': params})\n"
+        ),
+    },
     "no-nondeterminism-in-plan": {
         "path": "dgraph_tpu/plan.py",
         "bad": (

@@ -153,6 +153,9 @@ JAX_FREE_TARGETS = (
     # the span tracer is imported by the supervisor and loaded standalone
     # by bench's wedge-surviving loader — same contract as health.py
     "dgraph_tpu/obs/spans.py",
+    # shard/manifest integrity IO must run without a backend: the v8 plan
+    # artifact is repaired/inspected on hosts where jax may be wedged
+    "dgraph_tpu/plan_shards.py",
 )
 
 
@@ -531,6 +534,67 @@ def check_plan_determinism(relpath: str, tree: ast.AST, lines: list):
                 "no-nondeterminism-in-plan", relpath, node.lineno,
                 f"wall-clock read '{dotted}' in a plan-build path",
             ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# no-monolithic-plan-pickle
+# ---------------------------------------------------------------------------
+
+PLAN_BUILDERS = frozenset({
+    "build_edge_plan", "build_edge_plan_sharded", "cached_edge_plan",
+    "_finalize_plan", "assemble_plan", "load_sharded_plan",
+})
+
+
+def _mentions_plan(expr: ast.AST) -> Optional[str]:
+    """The identifier that makes ``expr`` plan-shaped (a name/attribute
+    containing 'plan', or a direct plan-builder call), else None."""
+    for node in ast.walk(expr):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Call):
+            name = _last_segment(node.func)
+            if name in PLAN_BUILDERS:
+                return name
+        if name and "plan" in name.lower():
+            return name
+    return None
+
+
+@rule(
+    "no-monolithic-plan-pickle",
+    "no atomic_pickle_dump of a whole EdgePlan outside the shard writer "
+    "(plan_shards.py): the monolithic plan pickle is the ~40+ GB "
+    "all-or-nothing artifact that OOM-killed the papers100M build — plans "
+    "persist as per-rank shards + a checksummed manifest (cache format v8)",
+    lambda relpath: (
+        relpath.startswith("dgraph_tpu/")
+        and relpath != "dgraph_tpu/plan_shards.py"
+    ),
+)
+def check_monolithic_plan_pickle(relpath: str, tree: ast.AST, lines: list):
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last_segment(node.func) != "atomic_pickle_dump":
+            continue
+        payloads = list(node.args[1:]) + [k.value for k in node.keywords]
+        for payload in payloads:
+            why = _mentions_plan(payload)
+            if why:
+                findings.append(Finding(
+                    "no-monolithic-plan-pickle", relpath, node.lineno,
+                    f"atomic_pickle_dump of plan-shaped payload ({why!r}) "
+                    f"outside the shard writer: persist plans as per-rank "
+                    f"shards + manifest (plan_shards.PlanShardWriter / "
+                    f"plan.build_plan_shards), not one monolithic pickle",
+                ))
+                break
     return findings
 
 

@@ -83,6 +83,13 @@ KNOWN_POINTS = {
     "step": "train/elastic.py::run_elastic, before each step (index=step)",
     "grads": "batch-owning loops, per step (poison -> non-finite grads)",
     "serve.infer": "serve/engine.py::ServeEngine.infer, before dispatch",
+    # sharded plan artifacts (plan_shards.py + plan.build_edge_plan_sharded):
+    # kill/poison/torn-write scenarios over the streaming per-rank build
+    # and the shard-aware loaders are deterministic through these
+    "plan.build_shard": "plan.py::build_plan_shards, before each "
+                        "rank's shard assembly (index=rank)",
+    "plan.write": "plan_shards.py::write_shard, before each shard write",
+    "plan.load": "plan_shards.py::read_shard, before each shard read",
 }
 
 ACTIONS = ("raise", "wedge", "sigterm", "poison")

@@ -95,6 +95,30 @@ def _selftest() -> dict:
         got = [s for s in range(10) if chaos.fire("grads", index=s)]
         _check(failures, got == [5, 6], f"poison window {got}, want [5, 6]")
 
+        # --- sharded-plan points (plan_shards.py / build_edge_plan_sharded):
+        # registered, parseable, and firing like any host boundary ---
+        for pt in ("plan.build_shard", "plan.write", "plan.load"):
+            _check(
+                failures, pt in chaos.KNOWN_POINTS,
+                f"plan point {pt!r} missing from KNOWN_POINTS",
+            )
+            (cl,) = chaos.parse_spec(f"{pt}=sigterm@2")
+            _check(
+                failures, cl.point == pt and cl.action == "sigterm",
+                f"plan point clause misparsed: {cl}",
+            )
+        chaos.arm("plan.write=raise@1")
+        plan_fired = []
+        for i in range(3):
+            try:
+                chaos.fire("plan.write")
+            except chaos.ChaosFault:
+                plan_fired.append(i)
+        _check(
+            failures, plan_fired == [1],
+            f"plan.write fired at {plan_fired}, want [1]",
+        )
+
         # --- attempt gating (the supervisor's restart ordinal) ---
         chaos.arm("step=raise@1:attempt=0", attempt=1)
         try:

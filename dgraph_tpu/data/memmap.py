@@ -16,6 +16,10 @@ The TPU-native version keeps the same shape:
 - :func:`generate_chunked`: stream-write a dataset in row chunks so the
   111M x 128 papers100M feature matrix is never in RAM during generation
   (reference memmap-generation loop, ``MAG240M_dataset.py:150-220``).
+- :func:`renumber_edges_chunked`: stream a renumbered ``[2, E]`` edge-list
+  copy to disk — the memmap'd input the streaming sharded plan build
+  (``plan.build_plan_shards``, cache format v8) assembles per-rank shards
+  from without ever holding the edge list resident.
 
 Everything here is host-side numpy except :func:`shard_rows_to_device`
 (lazy jax import), which streams shard blocks straight onto a device mesh so
@@ -106,6 +110,35 @@ def generate_chunked(
         out[lo:hi] = make_chunk(lo, hi)
     out.flush()
     return out
+
+
+def renumber_edges_chunked(
+    edges,
+    perm: np.ndarray,
+    out_path: str,
+    chunk_cols: int = 1 << 26,
+) -> np.ndarray:
+    """Apply a vertex renumbering to a ``[2, E]`` (memmap) edge list,
+    streaming the result TO DISK column-block by column-block.
+
+    Peak RAM is one ``[2, chunk_cols]`` block regardless of E — the
+    r5 papers100M plan stage's in-RAM renumbered copy (25.8 GB anon on
+    top of the plan transients) was part of what OOM-killed it at
+    ~130 GB.  Returns the result re-opened read-only with
+    ``mmap_mode="r"``: the plan core (``plan.build_plan_shards``) reads
+    src/dst in sequential passes, so file-backed pages reclaim under
+    memory pressure instead of counting against the OOM killer.
+    """
+    E = edges.shape[1]
+    out = np.lib.format.open_memmap(
+        out_path, mode="w+", dtype=np.int64, shape=(2, E)
+    )
+    for lo in range(0, E, chunk_cols):
+        blk = np.asarray(edges[:, lo : lo + chunk_cols])
+        out[:, lo : lo + blk.shape[1]] = perm[blk]
+    out.flush()
+    del out
+    return np.load(out_path, mmap_mode="r")
 
 
 def shard_rows(

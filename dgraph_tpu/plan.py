@@ -47,6 +47,7 @@ is needed (the reference needs an explicit recv-placement scatter,
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import logging
 from typing import Any, Optional
 
@@ -918,40 +919,23 @@ def build_edge_plan(
 
     Returns (plan, layout).
     """
-    edge_index = np.asarray(edge_index)
-    if edge_index.ndim != 2 or edge_index.shape[0] != 2:
-        raise ValueError(f"edge_index must be [2, E], got {edge_index.shape}")
-    if overlap is None:
-        overlap = resolve_overlap_intent()
-    _reject_incompatible_knobs(pad_multiple, e_pad, s_pad, overlap, sort_edges)
-    src_partition = np.asarray(src_partition)
-    homogeneous = dst_partition is None
-    dst_partition = src_partition if homogeneous else np.asarray(dst_partition)
+    pro = _plan_build_prologue(
+        edge_index, src_partition, dst_partition, edge_owner=edge_owner,
+        sort_edges=sort_edges, sort_route=sort_route, overlap=overlap,
+        pad_multiple=pad_multiple, e_pad=e_pad, s_pad=s_pad,
+        world_size=world_size,
+    )
+    src, dst, E = pro.src, pro.dst, pro.E
+    src_partition, dst_partition = pro.src_partition, pro.dst_partition
+    homogeneous = pro.homogeneous
+    src_counts, dst_counts = pro.src_counts, pro.dst_counts
+    src_offsets, dst_offsets = pro.src_offsets, pro.dst_offsets
+    sort_route, overlap = pro.sort_route, pro.overlap
     W = world_size
-    # copy=False: at billion-edge scale a silent astype copy is 26 GB
-    src = edge_index[0].astype(np.int64, copy=False)
-    dst = edge_index[1].astype(np.int64, copy=False)
-    E = len(src)
-
-    src_counts = np.bincount(src_partition, minlength=W).astype(np.int64)
-    dst_counts = np.bincount(dst_partition, minlength=W).astype(np.int64)
-    src_offsets = np.concatenate([[0], np.cumsum(src_counts)])
-    dst_offsets = np.concatenate([[0], np.cumsum(dst_counts)])
-    # contiguity check (cheap): partition must be non-decreasing
-    if np.any(np.diff(src_partition) < 0) or np.any(np.diff(dst_partition) < 0):
-        raise ValueError(
-            "partitions must be contiguous per-rank blocks; run "
-            "dgraph_tpu.partition.renumber_contiguous first"
-        )
-
-    if edge_owner not in ("src", "dst"):
-        raise ValueError("edge_owner must be 'src' or 'dst'")
     from dgraph_tpu import native as _native
 
     if use_native is None:
         use_native = sort_edges and _native.available() and E >= NATIVE_PLAN_MIN_EDGES
-    if sort_route is None:
-        sort_route = E < NATIVE_PLAN_MIN_EDGES
     if use_native:
         if not sort_edges:
             raise ValueError("native plan core always owner-sorts (sort_edges=True)")
@@ -962,7 +946,112 @@ def build_edge_plan(
             sort_route=sort_route, overlap=overlap,
         )
 
-    if edge_owner == "dst":  # validated above, before the native dispatch
+    prep = _numpy_plan_prep(
+        src, dst, src_partition, dst_partition, src_offsets, dst_offsets,
+        src_counts, dst_counts, W, edge_owner, sort_edges,
+        n_src_pad, n_dst_pad, e_pad, s_pad, pad_multiple,
+    )
+
+    # --- scatter into padded [W, E_pad] layout ---
+    def to_padded(vals, dtype, fill=0):
+        out = np.full((W, prep.e_pad), fill, dtype=dtype)
+        out[prep.edge_rank, prep.edge_slot] = vals
+        return out
+
+    edge_mask = np.zeros((W, prep.e_pad), dtype=np.float32)
+    edge_mask[prep.edge_rank, prep.edge_slot] = 1.0
+    # owner-side padding = n_pad: keeps sorted order monotone through the
+    # padded tail and is dropped by segment reductions
+    if prep.halo_side == "src":
+        src_idx_arr = to_padded(prep.halo_side_local_idx.astype(np.int32), np.int32)
+        dst_idx_arr = to_padded(
+            prep.own_local.astype(np.int32), np.int32, fill=prep.n_owner_pad)
+    else:
+        src_idx_arr = to_padded(
+            prep.own_local.astype(np.int32), np.int32, fill=prep.n_owner_pad)
+        dst_idx_arr = to_padded(prep.halo_side_local_idx.astype(np.int32), np.int32)
+
+    return _finalize_plan(
+        src_idx_arr=src_idx_arr, dst_idx_arr=dst_idx_arr, edge_mask=edge_mask,
+        src_counts=src_counts, dst_counts=dst_counts, e_counts=prep.e_counts,
+        send_idx=prep.send_idx, send_mask=prep.send_mask,
+        s_pad_val=prep.s_pad, W=W, E=E,
+        n_src_pad_val=prep.n_src_pad, n_dst_pad_val=prep.n_dst_pad,
+        e_pad_val=prep.e_pad,
+        halo_side=prep.halo_side, homogeneous=homogeneous,
+        edge_owner=edge_owner, owner_sorted=sort_edges,
+        halo_deltas=prep.halo_deltas,
+        edge_rank=prep.edge_rank, edge_slot=prep.edge_slot,
+        halo_counts=prep.halo_counts,
+        tag="", sort_route=sort_route, overlap=overlap,
+    )
+
+
+def _plan_build_prologue(
+    edge_index, src_partition, dst_partition, *, edge_owner, sort_edges,
+    sort_route, overlap, pad_multiple, e_pad, s_pad, world_size,
+):
+    """Shared validation + derived inputs for the monolithic AND streaming
+    plan builds (ONE copy, so the two entry points cannot drift): shape /
+    owner / knob rejection, the resolved overlap intent, per-rank
+    counts/offsets, the contiguity check, and the sort_route default."""
+    import types
+
+    edge_index = np.asarray(edge_index)
+    if edge_index.ndim != 2 or edge_index.shape[0] != 2:
+        raise ValueError(f"edge_index must be [2, E], got {edge_index.shape}")
+    if overlap is None:
+        overlap = resolve_overlap_intent()
+    _reject_incompatible_knobs(pad_multiple, e_pad, s_pad, overlap, sort_edges)
+    if edge_owner not in ("src", "dst"):
+        raise ValueError("edge_owner must be 'src' or 'dst'")
+    src_partition = np.asarray(src_partition)
+    homogeneous = dst_partition is None
+    dst_partition = src_partition if homogeneous else np.asarray(dst_partition)
+    W = world_size
+    # copy=False: at billion-edge scale a silent astype copy is 26 GB
+    src = edge_index[0].astype(np.int64, copy=False)
+    dst = edge_index[1].astype(np.int64, copy=False)
+    E = len(src)
+    src_counts = np.bincount(src_partition, minlength=W).astype(np.int64)
+    dst_counts = np.bincount(dst_partition, minlength=W).astype(np.int64)
+    src_offsets = np.concatenate([[0], np.cumsum(src_counts)])
+    dst_offsets = np.concatenate([[0], np.cumsum(dst_counts)])
+    # contiguity check (cheap): partition must be non-decreasing
+    if np.any(np.diff(src_partition) < 0) or np.any(np.diff(dst_partition) < 0):
+        raise ValueError(
+            "partitions must be contiguous per-rank blocks; run "
+            "dgraph_tpu.partition.renumber_contiguous first"
+        )
+    if sort_route is None:
+        sort_route = E < NATIVE_PLAN_MIN_EDGES
+    return types.SimpleNamespace(
+        src=src, dst=dst, E=E,
+        src_partition=src_partition, dst_partition=dst_partition,
+        homogeneous=homogeneous,
+        src_counts=src_counts, dst_counts=dst_counts,
+        src_offsets=src_offsets, dst_offsets=dst_offsets,
+        sort_route=sort_route, overlap=overlap,
+    )
+
+
+def _numpy_plan_prep(
+    src, dst, src_partition, dst_partition, src_offsets, dst_offsets,
+    src_counts, dst_counts, W, edge_owner, sort_edges,
+    n_src_pad, n_dst_pad, e_pad, s_pad, pad_multiple,
+):
+    """Host-side skeleton of the numpy plan build: every per-edge / per-peer
+    intermediate needed to assemble the padded index arrays, WITHOUT
+    materializing any ``[W, E_pad]`` stack.  The monolithic path scatters
+    the whole stack from this in one shot; the streaming path
+    (:func:`build_edge_plan_sharded`) assembles one rank's rows at a time
+    from the same skeleton, so the two builds cannot diverge — the
+    resumed/streamed plan is bit-identical to the in-RAM one (pinned by
+    ``tests/test_plan_shards.py``)."""
+    import types
+
+    E = len(src)
+    if edge_owner == "dst":
         owner = dst_partition[dst]
         halo_side = "src"
         halo_vid, halo_part = src, src_partition
@@ -1011,7 +1100,6 @@ def build_edge_plan(
     if int(halo_counts.max(initial=0)) > S_pad:
         raise ValueError(f"s_pad={S_pad} < max per-peer halo {int(halo_counts.max())}")
 
-    n_halo_side_counts = src_counts if halo_side == "src" else dst_counts
     halo_side_offsets = src_offsets if halo_side == "src" else dst_offsets
     N_src_pad = n_src_pad if n_src_pad is not None else _pad_to(int(src_counts.max(initial=1)), pad_multiple)
     N_dst_pad = n_dst_pad if n_dst_pad is not None else _pad_to(int(dst_counts.max(initial=1)), pad_multiple)
@@ -1062,34 +1150,17 @@ def build_edge_plan(
         remote_slot = np.zeros(E, dtype=np.int64)
     halo_side_local_idx = np.where(halo_is_local, local_halo_side, remote_slot)
 
-    # --- scatter into padded [W, E_pad] layout ---
-    def to_padded(vals, dtype, fill=0):
-        out = np.full((W, E_pad), fill, dtype=dtype)
-        out[edge_rank, edge_slot] = vals
-        return out
-
-    edge_mask = np.zeros((W, E_pad), dtype=np.float32)
-    edge_mask[edge_rank, edge_slot] = 1.0
     n_owner_pad = N_dst_pad if edge_owner == "dst" else N_src_pad
-    # owner-side padding = n_pad: keeps sorted order monotone through the
-    # padded tail and is dropped by segment reductions
-    if halo_side == "src":
-        src_idx_arr = to_padded(halo_side_local_idx.astype(np.int32), np.int32)
-        dst_idx_arr = to_padded(own_local.astype(np.int32), np.int32, fill=n_owner_pad)
-    else:
-        src_idx_arr = to_padded(own_local.astype(np.int32), np.int32, fill=n_owner_pad)
-        dst_idx_arr = to_padded(halo_side_local_idx.astype(np.int32), np.int32)
-
-    return _finalize_plan(
-        src_idx_arr=src_idx_arr, dst_idx_arr=dst_idx_arr, edge_mask=edge_mask,
-        src_counts=src_counts, dst_counts=dst_counts, e_counts=e_counts,
-        send_idx=send_idx, send_mask=send_mask, s_pad_val=S_pad, W=W, E=E,
-        n_src_pad_val=N_src_pad, n_dst_pad_val=N_dst_pad, e_pad_val=E_pad,
-        halo_side=halo_side, homogeneous=homogeneous, edge_owner=edge_owner,
-        owner_sorted=sort_edges,
+    return types.SimpleNamespace(
+        W=W, E=E, halo_side=halo_side, e_counts=e_counts, e_pad=E_pad,
+        edge_rank=edge_rank, edge_slot=edge_slot, cross=cross,
+        halo_counts=halo_counts, s_pad=S_pad,
+        n_src_pad=N_src_pad, n_dst_pad=N_dst_pad, n_halo_pad=N_halo_pad,
+        n_owner_pad=n_owner_pad,
+        send_idx=send_idx, send_mask=send_mask,
+        own_local=own_local, halo_side_local_idx=halo_side_local_idx,
+        src_counts=src_counts, dst_counts=dst_counts,
         halo_deltas=tuple(int(d) for d in np.unique((needer - sender) % W)),
-        edge_rank=edge_rank, edge_slot=edge_slot, halo_counts=halo_counts,
-        tag="", sort_route=sort_route, overlap=overlap,
     )
 
 
@@ -1204,49 +1275,45 @@ def _finalize_plan(
     return plan, layout
 
 
-def _build_overlap_spec(
-    src_idx_arr, dst_idx_arr, edge_mask, halo_side, n_src_pad, n_dst_pad,
-    s_pad, W, e_pad, owner_sorted, scatter_block_e, scatter_block_n,
-) -> OverlapSpec:
-    """Derive the interior/boundary edge split from the assembled padded
-    index arrays — shared by the numpy and native builders (both feed the
-    same arrays through ``_finalize_plan``, so the split cannot diverge
-    between them). See :class:`OverlapSpec` for the index conventions."""
-    halo_idx = src_idx_arr if halo_side == "src" else dst_idx_arr
-    n_halo_pad = n_src_pad if halo_side == "src" else n_dst_pad
-    n_owner_pad = n_dst_pad if halo_side == "src" else n_src_pad
-    live = edge_mask > 0
-    is_bnd = live & (halo_idx >= n_halo_pad)
-    is_int = live & ~is_bnd
-    n_int = is_int.sum(axis=1).astype(np.int64)
-    n_bnd = is_bnd.sum(axis=1).astype(np.int64)
-    int_max = int(n_int.max(initial=1))
-    bnd_max = int(n_bnd.max(initial=1))
-    # subset padding follows the plan's edge-pad alignment rule (lane tile
-    # floor of 8; Pallas scatter-block alignment once at kernel scale)
-    e_int_pad = _pad_to(int_max, _edge_pad_align(int_max, 8))
-    e_bnd_pad = _pad_to(bnd_max, _edge_pad_align(bnd_max, 8))
+def _overlap_rows_for_rank(
+    src_row, dst_row, mask_row, *, halo_side, n_halo_pad, n_owner_pad,
+    s_pad, W, e_pad, e_int_pad, e_bnd_pad, owner_sorted,
+    scatter_block_e, scatter_block_n,
+):
+    """ONE rank's interior/boundary split rows + Pallas hints — the single
+    per-rank core behind both build modes: the monolithic
+    :func:`_build_overlap_spec` stacks these rows into an
+    :class:`OverlapSpec`, and the streaming shard assembler
+    (:func:`_assemble_overlap_rows`) ships them in the shard payload, so
+    the fill/rebase/hint conventions cannot diverge between the two.
 
-    def subset(sel, e_sub_pad):
-        epos = np.full((W, e_sub_pad), e_pad, np.int32)
-        s_arr = np.full((W, e_sub_pad), n_owner_pad if halo_side == "dst"
+    Interior halo-side padded fill is OUT of the local table
+    (``n_halo_pad``); owner-side padded fill is ``n_owner_pad`` (monotone
+    tail); ``epos`` fill is ``e_pad``; the boundary halo-side entry is
+    rebased into the ``[0, W*s_pad)`` exchange buffer (padded slots ->
+    ``W*s_pad``, out of range of the buffer)."""
+    halo_row = src_row if halo_side == "src" else dst_row
+    live = mask_row > 0
+    is_bnd = live & (halo_row >= n_halo_pad)
+    is_int = live & ~is_bnd
+
+    def subset(sel_mask, e_sub_pad):
+        pos = np.nonzero(sel_mask)[0]
+        k = len(pos)
+        epos = np.full(e_sub_pad, e_pad, np.int32)
+        s_arr = np.full(e_sub_pad, n_owner_pad if halo_side == "dst"
                         else n_halo_pad, np.int32)
-        d_arr = np.full((W, e_sub_pad), n_owner_pad if halo_side == "src"
+        d_arr = np.full(e_sub_pad, n_owner_pad if halo_side == "src"
                         else n_halo_pad, np.int32)
-        mask = np.zeros((W, e_sub_pad), np.float32)
-        for r in range(W):
-            pos = np.nonzero(sel[r])[0]
-            k = len(pos)
-            epos[r, :k] = pos
-            s_arr[r, :k] = src_idx_arr[r, pos]
-            d_arr[r, :k] = dst_idx_arr[r, pos]
-            mask[r, :k] = 1.0
+        mask = np.zeros(e_sub_pad, np.float32)
+        epos[:k] = pos
+        s_arr[:k] = src_row[pos]
+        d_arr[:k] = dst_row[pos]
+        mask[:k] = 1.0
         return epos, s_arr, d_arr, mask
 
     int_epos, int_src, int_dst, int_mask = subset(is_int, e_int_pad)
     bnd_epos, bnd_src, bnd_dst, bnd_mask = subset(is_bnd, e_bnd_pad)
-    # rebase the boundary halo-side entry into the [0, W*s_pad) halo
-    # buffer (padded slots -> W*s_pad, out of range of the buffer)
     bnd_halo = bnd_src if halo_side == "src" else bnd_dst
     rebased = np.where(
         bnd_mask > 0, bnd_halo - n_halo_pad, W * s_pad
@@ -1255,35 +1322,81 @@ def _build_overlap_spec(
         bnd_src = rebased
     else:
         bnd_dst = rebased
-    # interior halo-side padded fill must be OUT of the local table
-    # (n_halo_pad), which `subset` already wrote; owner-side padded fill is
-    # n_owner_pad (monotone tail) likewise. Pallas hints for the owner-side
-    # sorted reductions over each subset:
     interior_mc = boundary_mc = 1
     if owner_sorted:
         from dgraph_tpu.ops.pallas_segment import max_chunks_hint
 
         int_owner = int_dst if halo_side == "src" else int_src
         bnd_owner = bnd_dst if halo_side == "src" else bnd_src
-        interior_mc = max(
-            max_chunks_hint(int_owner[r], n_owner_pad,
-                            block_e=scatter_block_e, block_n=scatter_block_n)
-            for r in range(W)
+        interior_mc = max_chunks_hint(
+            int_owner, n_owner_pad,
+            block_e=scatter_block_e, block_n=scatter_block_n,
         )
-        boundary_mc = max(
-            max_chunks_hint(bnd_owner[r], n_owner_pad,
-                            block_e=scatter_block_e, block_n=scatter_block_n)
-            for r in range(W)
+        boundary_mc = max_chunks_hint(
+            bnd_owner, n_owner_pad,
+            block_e=scatter_block_e, block_n=scatter_block_n,
         )
+    rows = {
+        "int_src": int_src, "int_dst": int_dst, "int_mask": int_mask,
+        "int_epos": int_epos,
+        "bnd_src": bnd_src, "bnd_dst": bnd_dst, "bnd_mask": bnd_mask,
+        "bnd_epos": bnd_epos,
+        "num_interior": int(is_int.sum()),
+        "num_boundary": int(is_bnd.sum()),
+    }
+    return rows, interior_mc, boundary_mc
+
+
+def _build_overlap_spec(
+    src_idx_arr, dst_idx_arr, edge_mask, halo_side, n_src_pad, n_dst_pad,
+    s_pad, W, e_pad, owner_sorted, scatter_block_e, scatter_block_n,
+) -> OverlapSpec:
+    """Derive the interior/boundary edge split from the assembled padded
+    index arrays — shared by the numpy and native builders (both feed the
+    same arrays through ``_finalize_plan``, so the split cannot diverge
+    between them), and each rank's rows come from the same per-rank core
+    the streaming shard builder uses (:func:`_overlap_rows_for_rank`).
+    See :class:`OverlapSpec` for the index conventions."""
+    halo_idx = src_idx_arr if halo_side == "src" else dst_idx_arr
+    n_halo_pad = n_src_pad if halo_side == "src" else n_dst_pad
+    n_owner_pad = n_dst_pad if halo_side == "src" else n_src_pad
+    live = edge_mask > 0
+    is_bnd = live & (halo_idx >= n_halo_pad)
+    n_bnd = is_bnd.sum(axis=1).astype(np.int64)
+    n_int = live.sum(axis=1).astype(np.int64) - n_bnd
+    int_max = int(n_int.max(initial=1))
+    bnd_max = int(n_bnd.max(initial=1))
+    # subset padding follows the plan's edge-pad alignment rule (lane tile
+    # floor of 8; Pallas scatter-block alignment once at kernel scale)
+    e_int_pad = _pad_to(int_max, _edge_pad_align(int_max, 8))
+    e_bnd_pad = _pad_to(bnd_max, _edge_pad_align(bnd_max, 8))
+
+    per_rank = [
+        _overlap_rows_for_rank(
+            src_idx_arr[r], dst_idx_arr[r], edge_mask[r],
+            halo_side=halo_side, n_halo_pad=n_halo_pad,
+            n_owner_pad=n_owner_pad, s_pad=s_pad, W=W, e_pad=e_pad,
+            e_int_pad=e_int_pad, e_bnd_pad=e_bnd_pad,
+            owner_sorted=owner_sorted, scatter_block_e=scatter_block_e,
+            scatter_block_n=scatter_block_n,
+        )
+        for r in range(W)
+    ]
+    rows = [p[0] for p in per_rank]
+
+    def stack(key):
+        return np.stack([row[key] for row in rows])
+
     return OverlapSpec(
-        int_src=int_src, int_dst=int_dst, int_mask=int_mask,
-        int_epos=int_epos,
-        bnd_src=bnd_src, bnd_dst=bnd_dst, bnd_mask=bnd_mask,
-        bnd_epos=bnd_epos,
+        int_src=stack("int_src"), int_dst=stack("int_dst"),
+        int_mask=stack("int_mask"), int_epos=stack("int_epos"),
+        bnd_src=stack("bnd_src"), bnd_dst=stack("bnd_dst"),
+        bnd_mask=stack("bnd_mask"), bnd_epos=stack("bnd_epos"),
         num_interior=n_int.astype(np.int32),
         num_boundary=n_bnd.astype(np.int32),
         e_int_pad=e_int_pad, e_bnd_pad=e_bnd_pad,
-        interior_mc=interior_mc, boundary_mc=boundary_mc,
+        interior_mc=max(p[1] for p in per_rank),
+        boundary_mc=max(p[2] for p in per_rank),
     )
 
 
@@ -1347,6 +1460,480 @@ def _build_edge_plan_native(
         halo_counts=halo_counts, tag=" (native core)", sort_route=sort_route,
         overlap=overlap,
     )
+
+
+# ---------------------------------------------------------------------------
+# Streaming per-rank plan builds (sharded artifacts, cache format v8)
+# ---------------------------------------------------------------------------
+
+
+def _shard_statics(prep, *, homogeneous, edge_owner, sort_edges, sort_route,
+                   overlap) -> dict:
+    """The manifest's JSON-able static description of a sharded plan —
+    everything :func:`assemble_plan` needs besides the per-rank payloads.
+    Per-rank Pallas hints are maxed in at finalize time
+    (:func:`build_edge_plan_sharded`)."""
+    st = {
+        "world_size": int(prep.W),
+        "n_src_pad": int(prep.n_src_pad),
+        "n_dst_pad": int(prep.n_dst_pad),
+        "e_pad": int(prep.e_pad),
+        "s_pad": int(prep.s_pad),
+        "halo_side": prep.halo_side,
+        "homogeneous": bool(homogeneous),
+        "edge_owner": edge_owner,
+        "owner_sorted": bool(sort_edges),
+        "sort_route": bool(sort_route),
+        "overlap": bool(overlap),
+        "scatter_block_e": SCATTER_BLOCK_E,
+        "scatter_block_n": SCATTER_BLOCK_N,
+        "halo_deltas": [int(d) for d in prep.halo_deltas],
+    }
+    if overlap:
+        # subset pads are global maxima over ranks — computable from the
+        # skeleton alone (boundary == cross edges), so every shard pads
+        # its subsets identically whether built in one run or resumed
+        n_bnd = np.bincount(
+            prep.edge_rank[prep.cross], minlength=prep.W
+        ).astype(np.int64)
+        n_int = prep.e_counts - n_bnd
+        int_max = int(n_int.max(initial=1))
+        bnd_max = int(n_bnd.max(initial=1))
+        st["e_int_pad"] = _pad_to(int_max, _edge_pad_align(int_max, 8))
+        st["e_bnd_pad"] = _pad_to(bnd_max, _edge_pad_align(bnd_max, 8))
+    return st
+
+
+def shard_nbytes_estimate(statics: dict) -> int:
+    """Upper-bound bytes of ONE rank's shard payload, from the manifest
+    statics alone — the number the streaming build's upfront memory-budget
+    check uses (so an over-budget build fails before assembling anything)."""
+    e_pad, W, s_pad = statics["e_pad"], statics["world_size"], statics["s_pad"]
+    n = e_pad * (4 + 4 + 4)  # src/dst idx + mask
+    if statics.get("sort_route"):
+        n += 2 * e_pad * 4  # halo_sort_perm + halo_sorted_ids
+    if statics.get("overlap"):
+        n += (statics["e_int_pad"] + statics["e_bnd_pad"]) * 4 * 4
+    n += 2 * W * s_pad * 4  # send_idx + send_mask rows
+    return n
+
+
+def _assemble_shard_payload(prep, r: int, *, sort_edges: bool,
+                            sort_route: bool, overlap: bool,
+                            overlap_pads: tuple = (None, None)):
+    """One rank's plan arrays + Pallas hints, assembled from the shared
+    numpy skeleton. Row-for-row identical to what the monolithic path's
+    ``[W, E_pad]`` stack holds at index ``r`` (the property the
+    kill-and-resume bit-parity pin rides on)."""
+    W, E_pad = prep.W, prep.e_pad
+    sel = prep.edge_rank == r
+    slots = prep.edge_slot[sel]
+    halo_row = np.zeros(E_pad, np.int32)
+    halo_row[slots] = prep.halo_side_local_idx[sel].astype(np.int32)
+    own_row = np.full(E_pad, prep.n_owner_pad, np.int32)
+    own_row[slots] = prep.own_local[sel].astype(np.int32)
+    mask_row = np.zeros(E_pad, np.float32)
+    mask_row[slots] = 1.0
+    if prep.halo_side == "src":
+        src_row, dst_row = halo_row, own_row
+    else:
+        src_row, dst_row = own_row, halo_row
+
+    hints = {"scatter_mc": 1, "gather_mv": 0, "halo_sort_mc": 1,
+             "interior_mc": 1, "boundary_mc": 1}
+    if sort_edges:
+        from dgraph_tpu.ops.pallas_segment import (
+            max_chunks_hint,
+            max_vblocks_hint,
+        )
+
+        hints["scatter_mc"] = max_chunks_hint(
+            own_row, prep.n_owner_pad,
+            block_e=SCATTER_BLOCK_E, block_n=SCATTER_BLOCK_N,
+        )
+        hints["gather_mv"] = max_vblocks_hint(
+            own_row, prep.n_owner_pad,
+            block_e=SCATTER_BLOCK_E, block_n=SCATTER_BLOCK_N,
+        )
+
+    perm = sorted_ids = None
+    if sort_route:
+        from dgraph_tpu.ops.pallas_segment import max_chunks_hint
+
+        n_halo_rows = prep.n_halo_pad + W * prep.s_pad
+        perm = np.argsort(halo_row, kind="stable").astype(np.int32)
+        sorted_ids = halo_row[perm]
+        hints["halo_sort_mc"] = max_chunks_hint(
+            sorted_ids, n_halo_rows,
+            block_e=SCATTER_BLOCK_E, block_n=SCATTER_BLOCK_N,
+        )
+
+    payload = {
+        "src_index": src_row,
+        "dst_index": dst_row,
+        "edge_mask": mask_row,
+        "num_local_src": int(prep.src_counts[r]),
+        "num_local_dst": int(prep.dst_counts[r]),
+        "num_edges": int(prep.e_counts[r]),
+        "send_idx": prep.send_idx[r],
+        "send_mask": prep.send_mask[r],
+        "halo_sort_perm": perm,
+        "halo_sorted_ids": sorted_ids,
+        "overlap": None,
+    }
+    if overlap:
+        payload["overlap"], ov_hints = _assemble_overlap_rows(
+            prep, src_row, dst_row, mask_row, sort_edges,
+            e_int_pad=overlap_pads[0], e_bnd_pad=overlap_pads[1],
+        )
+        hints.update(ov_hints)
+    return payload, hints
+
+
+def _assemble_overlap_rows(prep, src_row, dst_row, mask_row,
+                           sort_edges: bool, *, e_int_pad: int,
+                           e_bnd_pad: int):
+    """Per-rank interior/boundary split rows for one shard — a thin
+    wrapper over :func:`_overlap_rows_for_rank` (the same core the
+    monolithic :func:`_build_overlap_spec` stacks, so streamed and
+    monolithic splits are structurally identical). The subset pads are
+    the global maxima the manifest statics record
+    (:func:`_shard_statics`)."""
+    rows, interior_mc, boundary_mc = _overlap_rows_for_rank(
+        src_row, dst_row, mask_row,
+        halo_side=prep.halo_side, n_halo_pad=prep.n_halo_pad,
+        n_owner_pad=prep.n_owner_pad, s_pad=prep.s_pad, W=prep.W,
+        e_pad=prep.e_pad, e_int_pad=e_int_pad, e_bnd_pad=e_bnd_pad,
+        owner_sorted=sort_edges,
+        scatter_block_e=SCATTER_BLOCK_E, scatter_block_n=SCATTER_BLOCK_N,
+    )
+    return rows, {"interior_mc": interior_mc, "boundary_mc": boundary_mc}
+
+
+def _content_fingerprint(edge_index, src_partition, dst_partition) -> str:
+    """Streaming SHA-256 of the build inputs (dtype, shape, bytes) —
+    chunked, so a memmap'd edge list is read through in windows and
+    never materialized.  The default shard-build fingerprint when the
+    caller supplies none: without it, a resumed manifest could adopt
+    shards built from DIFFERENT edges that happen to share statics
+    (same per-rank counts and pads)."""
+    h = hashlib.sha256()
+    for arr in (edge_index, src_partition, dst_partition):
+        if arr is None:
+            h.update(b"|none")
+            continue
+        a = np.asarray(arr)
+        h.update(f"|{a.dtype.str}{a.shape}".encode())
+        if not a.flags.c_contiguous:
+            a = np.ascontiguousarray(a)
+        flat = a.reshape(-1)
+        step = max(1, (1 << 26) // max(a.itemsize, 1))  # 64 MiB windows
+        for i in range(0, flat.size, step):
+            h.update(flat[i:i + step].data)
+    return "content:" + h.hexdigest()[:24]
+
+
+def build_plan_shards(
+    edge_index: np.ndarray,
+    src_partition: np.ndarray,
+    dst_partition: Optional[np.ndarray] = None,
+    *,
+    out_dir: str,
+    world_size: int,
+    memory_budget_bytes: Optional[int] = None,
+    resume: bool = True,
+    rebuild_ranks: tuple = (),
+    write_layout: bool = True,
+    fingerprint: str = "",
+    edge_owner: str = "dst",
+    n_src_pad: Optional[int] = None,
+    n_dst_pad: Optional[int] = None,
+    e_pad: Optional[int] = None,
+    s_pad: Optional[int] = None,
+    pad_multiple: int = 8,
+    sort_edges: bool = True,
+    sort_route: Optional[bool] = None,
+    overlap: Optional[bool] = None,
+    use_native: Optional[bool] = None,
+) -> dict:
+    """Streaming-mode plan build: assemble ONE rank's shard at a time
+    (directly off a memmap'd edge list — nothing here forces the ``[2, E]``
+    input resident) and write it durably under ``out_dir`` (cache format
+    v8: ``shard_XXXX.pkl`` + checksummed ``manifest.json`` +
+    ``layout.pkl``, :mod:`dgraph_tpu.plan_shards`).  Returns the final
+    manifest WITHOUT assembling an in-RAM :class:`EdgePlan` — at real
+    papers100M scale the assembled stack is the ~40+ GB allocation this
+    mode exists to avoid; use :func:`build_edge_plan_sharded` (or
+    :func:`load_sharded_plan` with a rank subset) when you want one.
+
+    Peak RSS beyond the O(E) skeleton is ONE shard's arrays, enforced by
+    the memory budget (``memory_budget_bytes`` /
+    ``$DGRAPH_PLAN_MEMORY_BUDGET_MB``) which raises a structured
+    :class:`~dgraph_tpu.plan_shards.PlanBuildMemoryExceeded` instead of
+    getting OOM-killed — the r5 papers100M failure mode (ROADMAP item 3).
+    A killed build **resumes**: shards already durable in the manifest
+    (same fingerprint/format/statics, checksums intact) are skipped, and
+    the resumed result is bit-identical to an uninterrupted build.
+    ``rebuild_ranks`` forces named shards to rebuild even when the
+    manifest says they are done (the loaders' single-corrupt-shard repair
+    path).
+
+    The ``plan.build_shard`` chaos point fires before each rank's
+    assembly (index = rank), ``plan.write`` before each shard write.
+
+    The per-rank streaming core is the numpy skeleton
+    (:func:`_numpy_plan_prep`); ``use_native=True`` is rejected — the
+    native core fills the whole ``[W, E_pad]`` stack at once, which is
+    exactly the allocation this mode exists to avoid.
+
+    ``fingerprint`` defaults to a streaming content hash of the inputs
+    (:func:`_content_fingerprint`); pass an explicit value only when it
+    is already content-derived — a constant label would let a resumed
+    build adopt shards from different inputs with coinciding statics.
+    """
+    from dgraph_tpu import chaos
+    from dgraph_tpu import plan_shards as ps
+
+    if use_native:
+        raise ValueError(
+            "build_plan_shards streams through the numpy per-rank "
+            "core; use_native=True would materialize the full [W, E_pad] "
+            "stack this mode exists to avoid"
+        )
+    if not fingerprint:
+        # an un-keyed manifest must still be bound to the build INPUTS:
+        # statics (counts, pads) can coincide between two different edge
+        # lists, and a resumed build that adopts shards from the other
+        # one is a silently wrong comm plan
+        fingerprint = _content_fingerprint(
+            edge_index, src_partition, dst_partition
+        )
+    pro = _plan_build_prologue(
+        edge_index, src_partition, dst_partition, edge_owner=edge_owner,
+        sort_edges=sort_edges, sort_route=sort_route, overlap=overlap,
+        pad_multiple=pad_multiple, e_pad=e_pad, s_pad=s_pad,
+        world_size=world_size,
+    )
+    homogeneous, E, W = pro.homogeneous, pro.E, world_size
+    src_counts, dst_counts = pro.src_counts, pro.dst_counts
+    sort_route, overlap = pro.sort_route, pro.overlap
+
+    prep = _numpy_plan_prep(
+        pro.src, pro.dst, pro.src_partition, pro.dst_partition,
+        pro.src_offsets, pro.dst_offsets,
+        src_counts, dst_counts, W, edge_owner, sort_edges,
+        n_src_pad, n_dst_pad, e_pad, s_pad, pad_multiple,
+    )
+    statics = _shard_statics(
+        prep, homogeneous=homogeneous, edge_owner=edge_owner,
+        sort_edges=sort_edges, sort_route=sort_route, overlap=overlap,
+    )
+    writer = ps.PlanShardWriter(
+        out_dir,
+        fingerprint=fingerprint,
+        world_size=W,
+        statics=statics,
+        build_kwargs={
+            "edge_owner": edge_owner, "pad_multiple": pad_multiple,
+            "sort_edges": sort_edges, "sort_route": bool(sort_route),
+            "overlap": bool(overlap), "num_edges": E,
+        },
+        memory_budget_bytes=memory_budget_bytes,
+        resume=resume,
+        rebuild_ranks=rebuild_ranks,
+    )
+    # fail BEFORE assembling anything when even one shard cannot fit
+    writer.check_budget(shard_nbytes_estimate(statics))
+    built = 0
+    for r in range(W):
+        if writer.done(r):
+            continue
+        chaos.fire("plan.build_shard", index=r)
+        payload, hints = _assemble_shard_payload(
+            prep, r, sort_edges=sort_edges, sort_route=sort_route,
+            overlap=overlap,
+            overlap_pads=(statics.get("e_int_pad"), statics.get("e_bnd_pad")),
+        )
+        writer.write(r, payload, hints=hints)
+        built += 1
+    # plan-level Pallas hints are maxima over the per-shard values the
+    # manifest recorded — identical whether the shards were built in one
+    # pass or across resumed processes
+    entries = writer.manifest["shards"]
+    hint_names = ("scatter_mc", "gather_mv", "halo_sort_mc",
+                  "interior_mc", "boundary_mc")
+    hints_max = {
+        name: max(int(entries[str(r)].get("hints", {}).get(name, 0))
+                  for r in range(W))
+        for name in hint_names
+    }
+    # the layout sidecar is O(E) (edge_rank/edge_slot): at papers100M
+    # scale it pickles to tens of GB, and atomic_pickle_dump transiently
+    # doubles that on disk — callers that never consume it (the p100m
+    # plan stage, per-host shard loading) opt out with write_layout=False
+    layout_payload = None
+    if write_layout:
+        layout_payload = {
+            "edge_rank": prep.edge_rank,
+            "edge_slot": prep.edge_slot,
+            "halo_counts": prep.halo_counts,
+            "src_counts": src_counts,
+            "dst_counts": dst_counts,
+        }
+    manifest = writer.finalize(layout_payload, statics_update=hints_max)
+    _logger.info(
+        "sharded EdgePlan built in %s: W=%d E=%d e_pad=%d s_pad=%d "
+        "(%d shard(s) assembled this run, %d resumed)",
+        out_dir, W, E, prep.e_pad, prep.s_pad, built, W - built,
+    )
+    return manifest
+
+
+def build_edge_plan_sharded(
+    edge_index: np.ndarray,
+    src_partition: np.ndarray,
+    dst_partition: Optional[np.ndarray] = None,
+    *,
+    out_dir: str,
+    ranks: Optional[list] = None,
+    load_layout: Optional[bool] = None,
+    **build_kwargs: Any,
+) -> tuple:
+    """:func:`build_plan_shards` + :func:`load_sharded_plan`: the
+    streaming-mode :func:`build_edge_plan` for callers that want the
+    assembled ``(plan, layout)`` back (accepts every
+    :func:`build_plan_shards` keyword).
+
+    ``ranks=None`` assembles all ranks — bit-identical to the monolithic
+    build (pinned by ``tests/test_plan_shards.py``).  A subset returns a
+    plan whose leading axis is ``len(ranks)`` while every static —
+    including ``world_size`` — still describes the full W-rank world, the
+    each-host-loads-its-shard shape ``comm.multihost`` consumes.
+    ``load_layout=None`` loads the O(E) layout sidecar only for a
+    full-world load — a rank subset is the per-host path, which must not
+    read (or SHA-verify) an artifact as big as the edge list.
+    """
+    build_plan_shards(
+        edge_index, src_partition, dst_partition, out_dir=out_dir,
+        **build_kwargs,
+    )
+    if load_layout is None:
+        load_layout = ranks is None and build_kwargs.get("write_layout", True)
+    # verify=False: every shard was either written moments ago by this
+    # process or checksum-verified when the writer adopted it for resume —
+    # re-hashing a ~40+ GB artifact straight after writing it would double
+    # the build's IO. Cold loads (cached_edge_plan's hit path) verify.
+    return load_sharded_plan(
+        out_dir, ranks=ranks, load_layout=load_layout, verify=False
+    )
+
+
+def assemble_plan(manifest: dict, payloads: dict, ranks: list) -> EdgePlan:
+    """Stack per-rank shard payloads (``ranks`` order) into an
+    :class:`EdgePlan` under the manifest's statics. ``ranks == range(W)``
+    reproduces the monolithic build bit-for-bit; a subset yields the
+    partial stack a multi-controller host feeds its own devices."""
+    st = manifest["statics"]
+
+    def stack(key):
+        return np.stack([payloads[r][key] for r in ranks])
+
+    def counts(key):
+        return np.asarray([payloads[r][key] for r in ranks], np.int32)
+
+    sort_route = st.get("sort_route", False)
+    overlap_spec = None
+    if st.get("overlap"):
+        def ostack(key):
+            return np.stack([payloads[r]["overlap"][key] for r in ranks])
+
+        overlap_spec = OverlapSpec(
+            int_src=ostack("int_src"), int_dst=ostack("int_dst"),
+            int_mask=ostack("int_mask"), int_epos=ostack("int_epos"),
+            bnd_src=ostack("bnd_src"), bnd_dst=ostack("bnd_dst"),
+            bnd_mask=ostack("bnd_mask"), bnd_epos=ostack("bnd_epos"),
+            num_interior=np.asarray(
+                [payloads[r]["overlap"]["num_interior"] for r in ranks],
+                np.int32),
+            num_boundary=np.asarray(
+                [payloads[r]["overlap"]["num_boundary"] for r in ranks],
+                np.int32),
+            e_int_pad=int(st["e_int_pad"]), e_bnd_pad=int(st["e_bnd_pad"]),
+            interior_mc=int(st.get("interior_mc", 1)),
+            boundary_mc=int(st.get("boundary_mc", 1)),
+        )
+    return EdgePlan(
+        src_index=stack("src_index"),
+        dst_index=stack("dst_index"),
+        edge_mask=stack("edge_mask"),
+        num_local_src=counts("num_local_src"),
+        num_local_dst=counts("num_local_dst"),
+        num_edges=counts("num_edges"),
+        halo=HaloSpec(
+            send_idx=stack("send_idx"), send_mask=stack("send_mask"),
+            s_pad=int(st["s_pad"]),
+        ),
+        world_size=int(st["world_size"]),
+        n_src_pad=int(st["n_src_pad"]),
+        n_dst_pad=int(st["n_dst_pad"]),
+        e_pad=int(st["e_pad"]),
+        halo_side=st["halo_side"],
+        homogeneous=bool(st["homogeneous"]),
+        owner_sorted=bool(st["owner_sorted"]),
+        scatter_mc=int(st.get("scatter_mc", 1)),
+        scatter_block_e=int(st["scatter_block_e"]),
+        scatter_block_n=int(st["scatter_block_n"]),
+        halo_deltas=tuple(int(d) for d in st["halo_deltas"]),
+        halo_sort_perm=stack("halo_sort_perm") if sort_route else None,
+        halo_sorted_ids=stack("halo_sorted_ids") if sort_route else None,
+        halo_sort_mc=int(st.get("halo_sort_mc", 1)),
+        gather_mv=int(st.get("gather_mv", 0)),
+        overlap=overlap_spec,
+    )
+
+
+def load_sharded_plan(
+    plan_dir: str,
+    *,
+    ranks: Optional[list] = None,
+    verify: bool = True,
+    load_layout: bool = True,
+) -> tuple:
+    """Load ``(plan, layout)`` from a v8 sharded-plan directory, reading
+    ONLY the requested ranks' shards (checksum-verified on read; the
+    ``plan.load`` chaos point fires per shard).  Raises
+    :class:`~dgraph_tpu.plan_shards.PlanManifestError` /
+    :class:`~dgraph_tpu.plan_shards.PlanShardError` — callers that can
+    rebuild (``train.checkpoint.cached_edge_plan``) repair the named
+    shard; callers that cannot should surface the structured error.
+    ``load_layout=False`` returns ``layout=None`` (the layout sidecar is
+    O(E) — per-host shard loading has no use for it)."""
+    from dgraph_tpu import plan_shards as ps
+
+    manifest = ps.read_manifest(plan_dir)
+    if not manifest.get("complete"):
+        raise ps.PlanManifestError(
+            ps.manifest_path(plan_dir),
+            "build incomplete (resume it with build_edge_plan_sharded)",
+        )
+    W = manifest["world_size"]
+    rank_list = list(range(W)) if ranks is None else [int(r) for r in ranks]
+    payloads = {
+        r: ps.read_shard(plan_dir, r, manifest["shards"][str(r)], verify=verify)
+        for r in rank_list
+    }
+    plan = assemble_plan(manifest, payloads, rank_list)
+    layout = None
+    if load_layout:
+        lp = ps.read_layout(plan_dir, manifest, verify=verify)
+        layout = EdgePlanLayout(
+            edge_rank=lp["edge_rank"],
+            edge_slot=lp["edge_slot"],
+            halo_counts=lp["halo_counts"],
+            src_counts=lp["src_counts"],
+            dst_counts=lp["dst_counts"],
+        )
+    return plan, layout
 
 
 # ---------------------------------------------------------------------------

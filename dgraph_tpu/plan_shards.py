@@ -1,0 +1,671 @@
+"""Crash-safe sharded plan artifacts: per-rank shard IO + integrity manifest.
+
+The r5 papers100M campaign died at the plan, not the partition: the
+monolithic EdgePlan pickle is ~40+ GB and the in-RAM ``[W, E_pad]`` stack
+OOM-killed the build at ~130 GB (``logs/p100m_r5_stages.log``, ROADMAP
+item 3).  Cache format v8 replaces that single all-or-nothing artifact
+with **per-rank plan shards** plus a checksummed JSON **manifest**:
+
+- one ``shard_XXXX.pkl`` per rank (a plain dict of that rank's plan
+  arrays — see ``dgraph_tpu.plan._assemble_shard_payload`` for the
+  schema), each written with
+  :func:`~dgraph_tpu.train.checkpoint.atomic_pickle_dump`;
+- ``manifest.json`` recording, per shard, its SHA-256 and byte size, plus
+  the build fingerprint, :data:`~dgraph_tpu.train.checkpoint.
+  PLAN_FORMAT_VERSION`, the plan statics, and build progress — rewritten
+  atomically after every shard, so a SIGKILL mid-build **resumes** from
+  the last durable shard instead of restarting;
+- an optional ``layout.pkl`` sidecar (the
+  :class:`~dgraph_tpu.plan.EdgePlanLayout` arrays), checksummed the same
+  way.
+
+Loaders (:func:`~dgraph_tpu.train.checkpoint.cached_edge_plan`,
+``DistributedGraph.from_global``, serve, bench,
+``comm.multihost.process_local_plan_shards``) read only the shards they
+need, verify checksums on read, and on a corrupt / truncated / missing
+shard rebuild **just that shard** — mirroring ``restore_checkpoint``'s
+fall-back-past-corrupt-steps contract — degrading to a full rebuild only
+when the manifest itself is unreadable.
+
+Peak build memory beyond the O(E) numpy skeleton (the per-edge
+intermediates every plan build computes) is bounded by ONE shard, and
+the bound is enforced: the writer (and the streaming builder's upfront
+estimate) raise a structured :class:`PlanBuildMemoryExceeded` instead of
+getting OOM-killed.  What the budget does NOT cover is the skeleton
+itself — at billion-edge scale keep the edge list memmap'd
+(``data.memmap.renumber_edges_chunked``) and skip the O(E) layout
+sidecar (``build_plan_shards(write_layout=False)``).
+
+Chaos points (:mod:`dgraph_tpu.chaos`): ``plan.write`` fires before each
+shard write, ``plan.load`` before each shard read, and the builder fires
+``plan.build_shard`` before assembling each rank — so kill / poison /
+torn-write scenarios are deterministic and pinned in tests
+(``DGRAPH_CHAOS="plan.write=sigterm@2"`` kills the build after two
+durable shards; the rerun resumes bit-identically).
+
+This module is **jax-free by contract** (``analysis.lint``'s
+``jax-free-module`` rule): pure stdlib + numpy IO, so integrity checks
+and the ``--selftest`` CLI run without a backend.  Assembly into an
+:class:`~dgraph_tpu.plan.EdgePlan` lives in :mod:`dgraph_tpu.plan`.
+
+``python -m dgraph_tpu.plan_shards --selftest true`` is the compile-free
+smoke (run by ``scripts/check.py``): manifest round-trip + tamper
+detection, shard checksum / missing-file detection, writer resume,
+memory-budget enforcement, and the chaos points.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+from dgraph_tpu.train.checkpoint import atomic_pickle_dump
+
+_logger = logging.getLogger("dgraph_tpu.plan_shards")
+
+MANIFEST_NAME = "manifest.json"
+LAYOUT_NAME = "layout.pkl"
+
+# env knob: default per-shard memory budget in MiB for streaming plan
+# builds (0 / unset = unlimited). build_edge_plan_sharded's explicit
+# memory_budget_bytes argument wins.
+MEMORY_BUDGET_ENV = "DGRAPH_PLAN_MEMORY_BUDGET_MB"
+
+
+# ---------------------------------------------------------------------------
+# structured errors
+# ---------------------------------------------------------------------------
+
+
+class PlanManifestError(RuntimeError):
+    """The manifest is missing, unparseable, or fails its own checksum —
+    the one condition that degrades a shard-level repair to a full
+    rebuild."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"plan manifest {path!r} unreadable: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+class PlanShardError(RuntimeError):
+    """One shard is missing / truncated / checksum-mismatched — the caller
+    rebuilds THAT shard, not the world."""
+
+    def __init__(self, rank: int, path: str, reason: str):
+        super().__init__(
+            f"plan shard {rank} ({path!r}) unreadable: {reason}"
+        )
+        self.rank = rank
+        self.path = path
+        self.reason = reason
+
+    def record(self) -> dict:
+        return {
+            "kind": "plan_shard_error",
+            "rank": self.rank,
+            "path": self.path,
+            "reason": self.reason,
+        }
+
+
+class PlanBuildMemoryExceeded(RuntimeError):
+    """The streaming build would exceed its memory budget — raised
+    structured and early instead of letting the kernel OOM-kill a
+    multi-hour pipeline (the r5 failure mode)."""
+
+    def __init__(self, needed_bytes: int, budget_bytes: int,
+                 rank: Optional[int] = None):
+        where = "upfront estimate" if rank is None else f"shard {rank}"
+        super().__init__(
+            f"plan build {where} needs ~{needed_bytes / 2**20:.1f} MiB per "
+            f"shard, over the {budget_bytes / 2**20:.1f} MiB budget "
+            f"(raise it via memory_budget_bytes or ${MEMORY_BUDGET_ENV})"
+        )
+        self.needed_bytes = int(needed_bytes)
+        self.budget_bytes = int(budget_bytes)
+        self.rank = rank
+
+    def record(self) -> dict:
+        return {
+            "kind": "plan_build_memory_exceeded",
+            "needed_bytes": self.needed_bytes,
+            "budget_bytes": self.budget_bytes,
+            "rank": self.rank,
+        }
+
+
+def resolve_memory_budget(memory_budget_bytes: Optional[int]) -> Optional[int]:
+    """The explicit argument, else the env knob, else None (unlimited)."""
+    if memory_budget_bytes is not None:
+        return int(memory_budget_bytes) or None
+    mb = os.environ.get(MEMORY_BUDGET_ENV, "").strip()
+    return int(float(mb) * 2**20) if mb else None
+
+
+# ---------------------------------------------------------------------------
+# checksums + manifest IO
+# ---------------------------------------------------------------------------
+
+
+def _sha256_file(path: str, chunk: int = 1 << 22) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _manifest_body_sha(manifest: dict) -> str:
+    body = {k: v for k, v in manifest.items() if k != "manifest_sha256"}
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def manifest_path(plan_dir: str) -> str:
+    return os.path.join(plan_dir, MANIFEST_NAME)
+
+
+def shard_filename(rank: int) -> str:
+    return f"shard_{rank:04d}.pkl"
+
+
+def write_manifest(plan_dir: str, manifest: dict) -> None:
+    """Atomically write the manifest with a self-checksum (tmp + flush +
+    fsync + rename — the same torn-write discipline as
+    ``atomic_pickle_dump``)."""
+    manifest = dict(manifest)
+    manifest["manifest_sha256"] = _manifest_body_sha(manifest)
+    path = manifest_path(plan_dir)
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, sort_keys=True, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_manifest(plan_dir: str) -> dict:
+    """Read + checksum-verify the manifest; raises :class:`PlanManifestError`
+    on any failure (missing file, bad JSON, wrong kind, tampered body)."""
+    path = manifest_path(plan_dir)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except OSError as e:
+        raise PlanManifestError(path, f"{type(e).__name__}: {e}")
+    except ValueError as e:
+        raise PlanManifestError(path, f"bad JSON: {e}")
+    if not isinstance(manifest, dict) or manifest.get("kind") != "plan_manifest":
+        raise PlanManifestError(path, "not a plan manifest")
+    want = manifest.get("manifest_sha256")
+    if want != _manifest_body_sha(manifest):
+        raise PlanManifestError(path, "manifest checksum mismatch")
+    return manifest
+
+
+# ---------------------------------------------------------------------------
+# shard IO
+# ---------------------------------------------------------------------------
+
+
+def payload_nbytes(payload: Any) -> int:
+    """Total numpy bytes of one shard payload (dict/list/tuple tree) — the
+    number the memory budget is enforced against."""
+    if isinstance(payload, dict):
+        return sum(payload_nbytes(v) for v in payload.values())
+    if isinstance(payload, (list, tuple)):
+        return sum(payload_nbytes(v) for v in payload)
+    if isinstance(payload, np.ndarray):
+        return int(payload.nbytes)
+    return 0
+
+
+def write_shard(plan_dir: str, rank: int, payload: dict) -> dict:
+    """Write one rank's payload; returns its manifest entry
+    ``{"file", "sha256", "bytes"}``.  The ``plan.write`` chaos point fires
+    first — a ``sigterm`` clause here is the deterministic stand-in for a
+    SIGKILL mid-build."""
+    from dgraph_tpu import chaos
+
+    chaos.fire("plan.write")
+    fname = shard_filename(rank)
+    path = os.path.join(plan_dir, fname)
+    atomic_pickle_dump(path, payload)
+    return {
+        "file": fname,
+        "sha256": _sha256_file(path),
+        "bytes": os.path.getsize(path),
+    }
+
+
+def read_shard(plan_dir: str, rank: int, entry: dict, *,
+               verify: bool = True) -> dict:
+    """Read + verify one shard; raises :class:`PlanShardError` with a
+    ``reason`` of ``missing`` / ``checksum`` / ``unreadable``.  The
+    ``plan.load`` chaos point fires first."""
+    from dgraph_tpu import chaos
+
+    chaos.fire("plan.load")
+    path = os.path.join(plan_dir, entry["file"])
+    if not os.path.exists(path):
+        raise PlanShardError(rank, path, "missing")
+    if verify:
+        if os.path.getsize(path) != entry["bytes"]:
+            raise PlanShardError(
+                rank, path,
+                f"checksum (size {os.path.getsize(path)} != "
+                f"{entry['bytes']})",
+            )
+        got = _sha256_file(path)
+        if got != entry["sha256"]:
+            raise PlanShardError(rank, path, "checksum")
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except Exception as e:  # noqa: BLE001 — truncated/corrupt pickle
+        raise PlanShardError(rank, path, f"unreadable ({type(e).__name__}: {e})")
+
+
+def write_layout(plan_dir: str, payload: dict) -> dict:
+    """Write the (whole-graph) layout sidecar; returns its manifest entry."""
+    path = os.path.join(plan_dir, LAYOUT_NAME)
+    atomic_pickle_dump(path, payload)
+    return {
+        "file": LAYOUT_NAME,
+        "sha256": _sha256_file(path),
+        "bytes": os.path.getsize(path),
+    }
+
+
+def read_layout(plan_dir: str, manifest: dict, *, verify: bool = True) -> dict:
+    entry = manifest.get("layout")
+    if not entry:
+        raise PlanShardError(-1, os.path.join(plan_dir, LAYOUT_NAME), "missing")
+    path = os.path.join(plan_dir, entry["file"])
+    if not os.path.exists(path):
+        raise PlanShardError(-1, path, "missing")
+    if verify and _sha256_file(path) != entry["sha256"]:
+        raise PlanShardError(-1, path, "checksum")
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except Exception as e:  # noqa: BLE001
+        raise PlanShardError(-1, path, f"unreadable ({type(e).__name__}: {e})")
+
+
+def bad_shards(plan_dir: str, manifest: dict,
+               ranks: Optional[Iterable[int]] = None) -> dict:
+    """rank -> reason for every requested shard that fails its integrity
+    check (missing / size / checksum), WITHOUT unpickling."""
+    shards = manifest.get("shards", {})
+    out: dict = {}
+    want = [int(r) for r in (ranks if ranks is not None else shards)]
+    for rank in want:
+        entry = shards.get(str(rank))
+        if entry is None:
+            out[rank] = "not in manifest"
+            continue
+        path = os.path.join(plan_dir, entry["file"])
+        if not os.path.exists(path):
+            out[rank] = "missing"
+        elif os.path.getsize(path) != entry["bytes"]:
+            out[rank] = "truncated"
+        elif _sha256_file(path) != entry["sha256"]:
+            out[rank] = "checksum"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# streaming writer (resume + memory budget)
+# ---------------------------------------------------------------------------
+
+
+class PlanShardWriter:
+    """Streams per-rank shards into ``plan_dir`` with durable progress.
+
+    The manifest is rewritten (atomically) after every shard, so a killed
+    build resumes: a fresh writer with the same ``fingerprint`` picks up
+    the durable shard set (each re-verified by checksum) and
+    :meth:`done` reports which ranks can be skipped.  A fingerprint or
+    format-version mismatch discards the stale progress — a manifest can
+    never splice shards from two different builds.
+    """
+
+    def __init__(
+        self,
+        plan_dir: str,
+        *,
+        fingerprint: str,
+        world_size: int,
+        statics: dict,
+        build_kwargs: Optional[dict] = None,
+        memory_budget_bytes: Optional[int] = None,
+        resume: bool = True,
+        rebuild_ranks: Iterable[int] = (),
+    ):
+        from dgraph_tpu.train.checkpoint import PLAN_FORMAT_VERSION
+
+        self.plan_dir = plan_dir
+        self.budget = resolve_memory_budget(memory_budget_bytes)
+        os.makedirs(plan_dir, exist_ok=True)
+        self.manifest = {
+            "kind": "plan_manifest",
+            "format_version": PLAN_FORMAT_VERSION,
+            "fingerprint": fingerprint,
+            "world_size": int(world_size),
+            "statics": dict(statics),
+            "build_kwargs": dict(build_kwargs or {}),
+            "shards": {},
+            "layout": None,
+            "complete": False,
+        }
+        if resume:
+            self._adopt_progress(set(int(r) for r in rebuild_ranks))
+
+    def _adopt_progress(self, rebuild: set) -> None:
+        try:
+            old = read_manifest(self.plan_dir)
+        except PlanManifestError:
+            return
+        old_statics = old.get("statics", {})
+        same = all(
+            old.get(k) == self.manifest[k]
+            for k in ("format_version", "fingerprint", "world_size")
+        ) and all(
+            # finalize() folds maxed per-shard hints into the durable
+            # statics; a fresh writer only knows the build-time keys, so
+            # compare on those (extra finalized keys are not drift)
+            old_statics.get(k) == v
+            for k, v in self.manifest["statics"].items()
+        )
+        if not same:
+            # reclaim the stale artifact NOW: tens of GB of orphaned
+            # shards in a fixed out_dir is the disk-exhaustion mode that
+            # SIGBUS'd the r5 campaign (an orphaned tmp pickle filled the
+            # disk) — and delete the stale manifest too, so a kill before
+            # the first new shard cannot leave it referencing nothing
+            stale = [e["file"] for e in old.get("shards", {}).values()]
+            if old.get("layout"):
+                stale.append(old["layout"]["file"])
+            freed = 0
+            for fname in stale:
+                path = os.path.join(self.plan_dir, fname)
+                try:
+                    freed += os.path.getsize(path)
+                    os.unlink(path)
+                except OSError:
+                    pass
+            try:
+                os.unlink(manifest_path(self.plan_dir))
+            except OSError:
+                pass
+            _logger.info(
+                "plan shard progress in %s is from a different build "
+                "(fingerprint/format/statics changed); starting fresh "
+                "(%d stale file(s) deleted, %.1f MiB reclaimed)",
+                self.plan_dir, len(stale), freed / 2**20,
+            )
+            return
+        kept = {
+            rank: entry
+            for rank, entry in old.get("shards", {}).items()
+            if int(rank) not in rebuild
+        }
+        bad = bad_shards(self.plan_dir, {"shards": kept})
+        self.manifest["shards"] = {
+            rank: entry for rank, entry in kept.items()
+            if int(rank) not in bad
+        }
+        if self.manifest["shards"]:
+            _logger.info(
+                "resuming plan shard build in %s: %d/%d shards already "
+                "durable", self.plan_dir, len(self.manifest["shards"]),
+                self.manifest["world_size"],
+            )
+
+    def done(self, rank: int) -> bool:
+        """True when ``rank``'s shard is already durable (resume skip)."""
+        return str(rank) in self.manifest["shards"]
+
+    def check_budget(self, needed_bytes: int, rank: Optional[int] = None) -> None:
+        if self.budget is not None and needed_bytes > self.budget:
+            raise PlanBuildMemoryExceeded(needed_bytes, self.budget, rank)
+
+    def write(self, rank: int, payload: dict,
+              hints: Optional[dict] = None) -> None:
+        """Budget-check, write, and durably record one shard."""
+        self.check_budget(payload_nbytes(payload), rank)
+        entry = write_shard(self.plan_dir, rank, payload)
+        if hints:
+            entry["hints"] = {k: int(v) for k, v in hints.items()}
+        self.manifest["shards"][str(rank)] = entry
+        write_manifest(self.plan_dir, self.manifest)
+
+    def finalize(self, layout_payload: Optional[dict] = None,
+                 statics_update: Optional[dict] = None) -> dict:
+        """Mark the build complete (all ranks present) and return the
+        final manifest."""
+        missing = [
+            r for r in range(self.manifest["world_size"])
+            if str(r) not in self.manifest["shards"]
+        ]
+        if missing:
+            raise PlanShardError(
+                missing[0], self.plan_dir, "cannot finalize: shard not built"
+            )
+        if statics_update:
+            self.manifest["statics"].update(statics_update)
+        if layout_payload is not None:
+            self.manifest["layout"] = write_layout(self.plan_dir, layout_payload)
+        self.manifest["complete"] = True
+        write_manifest(self.plan_dir, self.manifest)
+        return dict(self.manifest)
+
+
+# ---------------------------------------------------------------------------
+# selftest CLI (compile-free; run by scripts/check.py)
+# ---------------------------------------------------------------------------
+
+
+def _selftest() -> dict:
+    import tempfile
+
+    from dgraph_tpu import chaos
+
+    failures: list = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    chaos.disarm()
+    try:
+        with tempfile.TemporaryDirectory(prefix="dgraph_plan_shards_") as tmp:
+            statics = {"e_pad": 8, "s_pad": 2}
+            w = PlanShardWriter(
+                tmp, fingerprint="fp0", world_size=3, statics=statics,
+            )
+            pay = {
+                "src_index": np.arange(8, dtype=np.int32),
+                "edge_mask": np.ones(8, np.float32),
+            }
+            for r in range(2):
+                w.write(r, pay, hints={"scatter_mc": r + 1})
+            # durable progress: a fresh writer resumes past ranks 0-1
+            w2 = PlanShardWriter(
+                tmp, fingerprint="fp0", world_size=3, statics=statics,
+            )
+            check(w2.done(0) and w2.done(1) and not w2.done(2),
+                  "writer resume did not adopt durable shards")
+            # finalize requires every shard
+            try:
+                w2.finalize()
+                failures.append("finalize accepted a missing shard")
+            except PlanShardError:
+                pass
+            w2.write(2, pay)
+            man = w2.finalize(layout_payload={"edge_rank": np.zeros(4, np.int8)})
+            check(man["complete"], "finalize did not mark complete")
+            man = read_manifest(tmp)
+            check(man["complete"] and len(man["shards"]) == 3,
+                  "manifest round-trip lost state")
+            got = read_shard(tmp, 1, man["shards"]["1"])
+            check(np.array_equal(got["src_index"], pay["src_index"]),
+                  "shard round-trip corrupted payload")
+            check(read_layout(tmp, man)["edge_rank"].dtype == np.int8,
+                  "layout round-trip corrupted payload")
+            # corruption detection: flip one byte -> checksum error, and
+            # bad_shards names exactly that rank
+            spath = os.path.join(tmp, man["shards"]["1"]["file"])
+            blob = bytearray(open(spath, "rb").read())
+            blob[len(blob) // 2] ^= 0xFF
+            open(spath, "wb").write(bytes(blob))
+            try:
+                read_shard(tmp, 1, man["shards"]["1"])
+                failures.append("checksum mismatch not detected")
+            except PlanShardError as e:
+                check(e.reason == "checksum" and e.record()["rank"] == 1,
+                      f"wrong shard error: {e.reason}")
+            check(bad_shards(tmp, man) == {1: "checksum"},
+                  f"bad_shards wrong: {bad_shards(tmp, man)}")
+            # missing-file detection
+            os.unlink(os.path.join(tmp, man["shards"]["0"]["file"]))
+            check(bad_shards(tmp, man, ranks=[0]) == {0: "missing"},
+                  "missing shard not detected")
+            # manifest tamper detection
+            mpath = manifest_path(tmp)
+            txt = open(mpath).read().replace('"complete": true',
+                                             '"complete": false')
+            open(mpath, "w").write(txt)
+            try:
+                read_manifest(tmp)
+                failures.append("manifest tamper not detected")
+            except PlanManifestError:
+                pass
+
+        # a different fingerprint discards the stale progress AND deletes
+        # the orphaned shard/manifest files (tens of GB in a fixed
+        # out_dir is the r5 disk-exhaustion mode)
+        with tempfile.TemporaryDirectory(prefix="dgraph_plan_shards_") as tmp:
+            w = PlanShardWriter(tmp, fingerprint="fp0", world_size=2,
+                                statics={})
+            w.write(0, {"a": np.zeros(4)})
+            w3 = PlanShardWriter(tmp, fingerprint="OTHER", world_size=2,
+                                 statics={})
+            check(not w3.done(0), "stale progress adopted across fingerprints")
+            check(not os.path.exists(os.path.join(tmp, shard_filename(0))),
+                  "stale shard file not deleted on fresh start")
+            check(not os.path.exists(manifest_path(tmp)),
+                  "stale manifest not deleted on fresh start")
+
+        # memory budget: structured raise, not an OOM kill
+        with tempfile.TemporaryDirectory(prefix="dgraph_plan_shards_") as tmp:
+            w = PlanShardWriter(
+                tmp, fingerprint="fp", world_size=1, statics={},
+                memory_budget_bytes=16,
+            )
+            try:
+                w.write(0, {"big": np.zeros(64, np.float32)})
+                failures.append("memory budget not enforced")
+            except PlanBuildMemoryExceeded as e:
+                rec = e.record()
+                check(rec["budget_bytes"] == 16 and rec["rank"] == 0
+                      and rec["needed_bytes"] >= 256,
+                      f"budget record malformed: {rec}")
+
+        # chaos points: plan.write / plan.load consult the registry
+        with tempfile.TemporaryDirectory(prefix="dgraph_plan_shards_") as tmp:
+            for pt in ("plan.build_shard", "plan.write", "plan.load"):
+                check(pt in chaos.KNOWN_POINTS,
+                      f"chaos point {pt!r} not registered")
+            w = PlanShardWriter(tmp, fingerprint="fp", world_size=2, statics={})
+            chaos.arm("plan.write=raise@1")
+            w.write(0, {"a": np.zeros(2)})
+            try:
+                w.write(1, {"a": np.zeros(2)})
+                failures.append("plan.write chaos clause did not fire")
+            except chaos.ChaosFault:
+                pass
+            chaos.arm("plan.load=raise@0")
+            man = read_manifest(tmp)
+            try:
+                read_shard(tmp, 0, man["shards"]["0"])
+                failures.append("plan.load chaos clause did not fire")
+            except chaos.ChaosFault:
+                pass
+            chaos.disarm()
+    finally:
+        chaos.reset()
+    return {"kind": "plan_shards_selftest", "failures": failures}
+
+
+def _main() -> None:
+    import dataclasses
+
+    from dgraph_tpu.obs.health import RunHealth
+    from dgraph_tpu.utils.cli import parse_config
+
+    @dataclasses.dataclass
+    class Config:
+        """Sharded plan artifact IO (``--selftest`` for the compile-free
+        tier-1/check.py smoke; default prints a manifest summary of
+        ``--plan_dir``)."""
+
+        selftest: bool = False
+        plan_dir: str = ""
+        indent: int = 0
+
+    cfg = parse_config(Config)
+    health = RunHealth.begin("plan_shards.cli")
+    if not cfg.selftest:
+        out: dict = {"kind": "plan_manifest_summary", "plan_dir": cfg.plan_dir}
+        if cfg.plan_dir:
+            try:
+                man = read_manifest(cfg.plan_dir)
+                out.update(
+                    complete=man["complete"],
+                    world_size=man["world_size"],
+                    fingerprint=man["fingerprint"],
+                    shards=len(man["shards"]),
+                    bad=bad_shards(cfg.plan_dir, man),
+                )
+            except PlanManifestError as e:
+                out["error"] = str(e)
+        out["run_health"] = health.finish(out.get("error"))
+        print(json.dumps(out, indent=cfg.indent or None))
+        return
+    try:
+        out = _selftest()
+    except BaseException as e:
+        print(json.dumps({
+            "kind": "plan_shards_selftest",
+            "failures": [f"crashed: {type(e).__name__}: {e}"],
+            "run_health": health.finish(
+                f"plan_shards selftest crashed: {type(e).__name__}: {e}",
+                wedge="stage_failure",
+            ),
+        }))
+        raise
+    failures = out["failures"]
+    out["run_health"] = health.finish(
+        "; ".join(failures) if failures else None,
+        wedge="stage_failure" if failures else None,
+    )
+    print(json.dumps(out, indent=cfg.indent or None))
+    if failures:
+        raise SystemExit("plan_shards selftest FAILED: " + "; ".join(failures))
+
+
+if __name__ == "__main__":
+    _main()

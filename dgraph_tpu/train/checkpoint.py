@@ -155,7 +155,12 @@ def checkpoint_keys(ckpt_dir: str, step: Optional[int] = None):
 # Bump whenever EdgePlan's fields/defaults change shape or meaning: stale
 # cache pickles must REBUILD, not silently inherit new class defaults for
 # fields they were never built with (e.g. scatter_block_e).
-PLAN_FORMAT_VERSION = 7  # v7: overlap (interior/boundary OverlapSpec for
+PLAN_FORMAT_VERSION = 8  # v8: sharded plan artifacts — per-rank
+# shard_XXXX.pkl files under plan_<key>/ with a checksummed manifest.json
+# (dgraph_tpu.plan_shards), streamed by plan.build_edge_plan_sharded,
+# loaded/repaired shard-by-shard here; the monolithic plan_<key>.pkl is
+# gone (a ~40+ GB all-or-nothing artifact at papers100M scale);
+# v7: overlap (interior/boundary OverlapSpec for
 # the compute–communication-overlap halo lowering);
 # v6: e_pad aligned to lcm(pad_multiple,
 # SCATTER_BLOCK_E) so pallas operands need no per-call re-pad copy;
@@ -187,9 +192,38 @@ def cached_edge_plan(
     edge_index: np.ndarray,
     src_partition: np.ndarray,
     dst_partition: Optional[np.ndarray] = None,
+    *,
+    ranks: Optional[list] = None,
+    load_layout: Optional[bool] = None,
+    memory_budget_bytes: Optional[int] = None,
+    verify: bool = True,
     **build_kwargs: Any,
 ):
-    """build_edge_plan with an on-disk cache (pickle of the numpy plan).
+    """build_edge_plan with an on-disk **sharded** cache (format v8).
+
+    The cached artifact is a directory ``plan_<key>/`` of per-rank shard
+    pickles plus a checksummed manifest (:mod:`dgraph_tpu.plan_shards`),
+    streamed by :func:`~dgraph_tpu.plan.build_edge_plan_sharded`.  Loads
+    verify every shard's checksum; a corrupt / truncated / missing shard
+    (or a shard deleted out from under a valid manifest) rebuilds **just
+    the bad shards** — logged with which shard triggered it, mirroring
+    :func:`restore_checkpoint`'s fall-back-past-corrupt-steps contract —
+    and only an unreadable manifest degrades to a full rebuild.  A
+    build killed mid-stream resumes from the manifest on the next call.
+
+    ``ranks`` loads only those shards (each-host-loads-its-shard; the
+    returned plan's leading axis is ``len(ranks)``, statics still
+    describe the full world) and defaults ``load_layout`` to False — the
+    layout sidecar is O(E), and a host loading two shards must not read
+    (or SHA-verify) an artifact as big as the edge list.
+    ``memory_budget_bytes`` bounds the streaming build's per-shard RSS
+    (:class:`~dgraph_tpu.plan_shards.PlanBuildMemoryExceeded`).
+
+    ``verify=False`` skips SHA-256 verification on warm hits — at
+    papers100M scale hashing the full artifact adds real wall time to
+    every load.  Torn/truncated shards still surface as unpickle
+    failures and take the same single-shard repair path; only silent
+    bit-flips in an intact-length pickle go undetected.
 
     A falsy ``cache_dir`` ("" / None) builds without caching — the CLIs'
     ``--plan_cache ""`` convention resolves here, not at every call site.
@@ -200,6 +234,14 @@ def cached_edge_plan(
     from dgraph_tpu.plan import build_edge_plan
 
     if not cache_dir:
+        if ranks is not None:
+            raise ValueError(
+                "cached_edge_plan(ranks=...) needs a cache_dir: per-rank "
+                "loading is a property of the sharded on-disk artifact"
+            )
+        # layout sidecar knobs describe the on-disk artifact; without a
+        # cache there is none (build_edge_plan would reject the kwarg)
+        build_kwargs.pop("write_layout", None)
         return build_edge_plan(
             edge_index, src_partition, dst_partition, **build_kwargs
         )
@@ -210,9 +252,24 @@ def cached_edge_plan(
     # silently ignore DGRAPH_TPU_SCATTER_BLOCK_E/N (ADVICE r2 #2).
     # Likewise the RESOLVED overlap intent: overlap=None defaults from the
     # env pin / adopted tuning record (plan.resolve_overlap_intent — the
-    # same rule the builder applies), and a warm spec-less pickle must
+    # same rule the builder applies), and a warm spec-less artifact must
     # not satisfy a build that now wants the interior/boundary split.
     from dgraph_tpu import plan as _plan
+    from dgraph_tpu import plan_shards as ps
+    from dgraph_tpu.plan import build_edge_plan_sharded, load_sharded_plan
+
+    # the v8 cache always streams through the numpy per-rank core: the
+    # native core fills the whole [W, E_pad] stack at once — the
+    # allocation the sharded artifact exists to avoid. The cores produce
+    # identical plans, so an explicit use_native only changes the build's
+    # time/RSS profile; honor old callers by ignoring it with a warning
+    # rather than crashing deep inside build_plan_shards.
+    if build_kwargs.pop("use_native", None):
+        _logger.warning(
+            "plan cache %s: use_native is ignored for sharded (v8) cache "
+            "builds — the streaming numpy core bounds peak memory by one "
+            "shard", cache_dir,
+        )
 
     overlap_resolved = build_kwargs.get("overlap")
     if overlap_resolved is None:
@@ -223,19 +280,53 @@ def cached_edge_plan(
         scatter_block_e=_plan.SCATTER_BLOCK_E,
         scatter_block_n=_plan.SCATTER_BLOCK_N,
         overlap=bool(overlap_resolved),
+        # write_layout is an artifact-shape knob, not a plan knob: the
+        # shards are bit-identical either way, and the loader self-heals
+        # a missing sidecar — keying on it would store a duplicate
+        # multi-GB artifact per spelling
         **{k: v for k, v in build_kwargs.items()
-           if k != "overlap" and (np.isscalar(v) or isinstance(v, str))},
+           if k not in ("overlap", "write_layout")
+           and (np.isscalar(v) or isinstance(v, str))},
     )
-    path = os.path.join(cache_dir, f"plan_{key}.pkl")
-    if os.path.exists(path):
-        try:
-            with open(path, "rb") as f:
-                return pickle.load(f)
-        except Exception as e:  # noqa: BLE001 — truncated/corrupt pickle
+    plan_dir = os.path.join(cache_dir, f"plan_{key}")
+
+    ll = (
+        load_layout if load_layout is not None
+        # no sidecar to load for a rank-subset (per-host) load, nor when
+        # the caller opted out of writing it in the first place
+        else ranks is None and build_kwargs.get("write_layout", True)
+    )
+
+    def _build(rebuild_ranks=()):
+        return build_edge_plan_sharded(
+            edge_index, src_partition, dst_partition,
+            out_dir=plan_dir, fingerprint=key, ranks=ranks, load_layout=ll,
+            memory_budget_bytes=memory_budget_bytes,
+            rebuild_ranks=rebuild_ranks,
+            **{**build_kwargs, "overlap": bool(overlap_resolved)},
+        )
+
+    try:
+        return load_sharded_plan(
+            plan_dir, ranks=ranks, load_layout=ll, verify=verify
+        )
+    except ps.PlanShardError as e:
+        # one bad shard is a shard-level repair, never a full rebuild:
+        # the builder resumes past every durable, checksum-intact shard
+        # and reassembles only what's broken (plus the named shard, for
+        # the unlikely checksum-intact-but-unpicklable case)
+        _logger.warning(
+            "plan cache %s: shard %s unreadable (%s); rebuilding that "
+            "shard", plan_dir, e.rank, e.reason,
+        )
+        return _build(rebuild_ranks=(e.rank,) if e.rank >= 0 else ())
+    except ps.PlanManifestError as e:
+        if os.path.exists(ps.manifest_path(plan_dir)):
+            # incomplete (killed mid-build -> resume) or corrupt (full
+            # rebuild; the writer discards unverifiable progress itself)
             _logger.warning(
-                "plan cache %s unreadable (%s: %s); rebuilding",
-                path, type(e).__name__, e,
+                "plan cache %s: %s; %s", plan_dir, e.reason,
+                "resuming the interrupted build"
+                if "incomplete" in e.reason else "rebuilding",
             )
-    result = build_edge_plan(edge_index, src_partition, dst_partition, **build_kwargs)
-    atomic_pickle_dump(path, result)
-    return result
+        return _build()

@@ -37,8 +37,24 @@ import sys
 import time
 from typing import Optional
 
-import dgraph_tpu.obs.spans as spans  # jax-free (lint-enforced)
-from dgraph_tpu.train.elastic import WEDGED_EXIT_CODE
+# bench.py's wedge-surviving supervisor loads this file STANDALONE (by
+# path, registered as ``_dgraph_train_supervise``) so its backend-probe
+# loop can run under this exact restart/backoff/budget policy without
+# importing the dgraph_tpu package — whose ``__init__`` imports jax (the
+# same contract obs/health.py and obs/spans.py carry).  The spans twin is
+# registered in sys.modules before this module is exec'd; the literal
+# fallbacks are the canonical contract values, pinned against the package
+# ones in tests/test_plan_shards.py.  Keyed on OUR module name so a
+# normal package import never takes this branch, even in a process that
+# also loaded bench's standalone twins.
+if __name__ == "_dgraph_train_supervise":  # standalone (bench supervisor)
+    spans = sys.modules["_dgraph_obs_spans"]
+    WEDGED_EXIT_CODE = 17  # train.elastic.WEDGED_EXIT_CODE
+    ATTEMPT_ENV_VAR = "DGRAPH_CHAOS_ATTEMPT"  # chaos.ATTEMPT_ENV_VAR
+else:
+    import dgraph_tpu.obs.spans as spans  # jax-free (lint-enforced)
+    from dgraph_tpu.chaos import ATTEMPT_ENV_VAR
+    from dgraph_tpu.train.elastic import WEDGED_EXIT_CODE
 
 
 @dataclasses.dataclass
@@ -53,6 +69,8 @@ class Config:
     backoff_max_s: float = 60.0
     restart_on_crash: bool = True  # False: only exit 17 restarts
     attempt_timeout_s: float = 0.0  # 0 = none; kill + restart past this
+    budget_s: float = 0.0  # 0 = none; overall fail-fast wall budget
+    stderr_path: str = ""  # capture child stderr here (truncated/attempt)
     ckpt_dir: str = ""  # lineage: record latest_step() resume points
     log_path: str = "logs/supervise.jsonl"
     indent: int = 0
@@ -88,8 +106,12 @@ def supervise(
     backoff_max_s: float = 60.0,
     restart_on_crash: bool = True,
     attempt_timeout_s: float = 0.0,
+    budget_s: float = 0.0,
     ckpt_dir: str = "",
     env: Optional[dict] = None,
+    stderr_path: str = "",
+    on_spawn=None,
+    on_attempt=None,
     _sleep=time.sleep,
 ) -> dict:
     """Run ``argv`` under restart-and-resume supervision; returns the
@@ -107,9 +129,30 @@ def supervise(
     Each restart sleeps ``min(backoff_s * backoff_factor**k, backoff_max_s)``
     first.  The child inherits the environment plus ``env`` plus
     ``DGRAPH_CHAOS_ATTEMPT=<attempt>``.
+
+    ``budget_s`` (0 = none) is an overall fail-fast wall budget across
+    attempts: once elapsed + the next backoff would cross it, the
+    supervisor stops restarting (``budget_exhausted`` in the lineage)
+    instead of burning its whole restart budget against a wedge — the
+    bench probe phase runs through here with ``--probe-budget-s`` as
+    this budget (ROADMAP item 5), and each attempt's timeout is clamped
+    to the remaining window.  Attempt 0 always runs (>= 1 s).
+
+    ``on_spawn(proc)`` is called with each child's ``Popen`` the moment
+    it exists (bench's SIGTERM handler kills the in-flight probe through
+    it); ``on_attempt(record)`` after each attempt resolves, with that
+    attempt's lineage record (live probe-history logging).  Both default
+    to no-ops and must not raise.
+
+    ``stderr_path`` (default "": inherit) redirects each child's stderr
+    to that file, truncated per attempt — so a child that dies in native
+    code (segfault, PJRT abort) still leaves a diagnosable tail for the
+    caller's failure record (bench's probe notes read it).
     """
-    from dgraph_tpu.chaos import ATTEMPT_ENV_VAR
-    from dgraph_tpu.obs.health import RunHealth
+    if "_dgraph_obs_health" in sys.modules:  # standalone (bench supervisor)
+        RunHealth = sys.modules["_dgraph_obs_health"].RunHealth
+    else:
+        from dgraph_tpu.obs.health import RunHealth
 
     # ONE trace per supervised run, one span per attempt: the restart
     # chain becomes a single timeline, and the children join it via the
@@ -120,11 +163,18 @@ def supervise(
     attempts = []
     rc: Optional[int] = None
     gave_up = False
+    budget_exhausted = False
+    t_start = time.monotonic()
     for attempt in range(max_restarts + 1):
         if attempt:
             delay = min(
                 backoff_s * backoff_factor ** (attempt - 1), backoff_max_s
             )
+            if budget_s and (
+                time.monotonic() - t_start + delay >= budget_s
+            ):
+                gave_up = budget_exhausted = True
+                break
             _sleep(delay)
         else:
             delay = 0.0
@@ -137,17 +187,34 @@ def supervise(
             **os.environ, **(env or {}), ATTEMPT_ENV_VAR: str(attempt),
             **spans.child_env(parent=attempt_span),
         }
+        # clamp the attempt timeout to the remaining budget window so one
+        # wedged child cannot blow past the overall fail-fast budget
+        # (attempt 0 always gets >= 1 s even under a tiny budget)
+        timeout = attempt_timeout_s or 0.0
+        if budget_s:
+            remaining = max(budget_s - (time.monotonic() - t_start), 1.0)
+            timeout = min(timeout, remaining) if timeout else remaining
         t0 = time.monotonic()
         timed_out = False
+        # truncate-per-attempt so the file always holds the LAST
+        # attempt's stderr — native crashes (segfault/PJRT abort) write
+        # nothing anywhere else, and the caller's failure record must be
+        # diagnosable without the console scrollback
+        stderr_fh = open(stderr_path, "wb") if stderr_path else None
         try:
-            rc = subprocess.run(
-                argv,
-                env=child_env,
-                timeout=attempt_timeout_s or None,
-            ).returncode
-        except subprocess.TimeoutExpired:
-            timed_out = True
-            rc = WEDGED_EXIT_CODE  # never reached its own watchdog: a wedge
+            proc = subprocess.Popen(argv, env=child_env, stderr=stderr_fh)
+            if on_spawn is not None:
+                on_spawn(proc)
+            try:
+                rc = proc.wait(timeout=timeout or None)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                timed_out = True
+                rc = WEDGED_EXIT_CODE  # never reached its own watchdog: a wedge
+        finally:
+            if stderr_fh is not None:
+                stderr_fh.close()
         wall_s = time.monotonic() - t0
         if rc == 0:
             outcome = "ok"
@@ -173,6 +240,8 @@ def supervise(
                 "span_id": attempt_span.span_id,
             }
         )
+        if on_attempt is not None:
+            on_attempt(attempts[-1])
         health.record_probe(
             attempt, wall_s,
             "ok" if rc == 0 else ("hang" if outcome in ("wedged", "timeout")
@@ -190,9 +259,15 @@ def supervise(
         error, wedge = None, None
     else:
         last = attempts[-1]["outcome"]
+        if budget_exhausted:
+            exhausted = f"; wall budget ({budget_s:g}s) exhausted"
+        elif gave_up:
+            exhausted = f"; restart budget ({max_restarts}) exhausted"
+        else:
+            exhausted = ""
         error = (
             f"child exited {rc} ({last}) after {restarts} restart(s)"
-            + (f"; restart budget ({max_restarts}) exhausted" if gave_up else "")
+            + exhausted
         )
         wedge = (
             "watchdog_timeout" if last in ("wedged", "timeout")
@@ -209,6 +284,7 @@ def supervise(
         "restarts": restarts,
         "final_exit_code": rc,
         "gave_up": gave_up,
+        "budget_exhausted": budget_exhausted,
         "final_step": _latest_step(ckpt_dir),
         "run_health": health.finish(error, wedge),
     }
@@ -229,6 +305,8 @@ def main(cfg: Config) -> dict:
         backoff_max_s=cfg.backoff_max_s,
         restart_on_crash=cfg.restart_on_crash,
         attempt_timeout_s=cfg.attempt_timeout_s,
+        budget_s=cfg.budget_s,
+        stderr_path=cfg.stderr_path,
         ckpt_dir=cfg.ckpt_dir,
     )
     _append_jsonl(cfg.log_path, lineage)
@@ -260,6 +338,7 @@ if __name__ == "__main__":
                     "restarts": 0,
                     "final_exit_code": None,
                     "gave_up": False,
+                    "budget_exhausted": False,
                     "run_health": h.finish(
                         f"supervisor crashed: {type(e).__name__}: {e}",
                         "stage_failure",

@@ -30,6 +30,10 @@ PASSES = [
      [sys.executable, "-m", "dgraph_tpu.analysis", "--selftest", "true"]),
     ("spans-selftest",
      [sys.executable, "-m", "dgraph_tpu.obs.spans", "--selftest", "true"]),
+    # sharded plan artifacts (cache format v8): manifest/shard integrity,
+    # writer resume, memory budget, chaos points — pure numpy+stdlib IO
+    ("plan-shards-selftest",
+     [sys.executable, "-m", "dgraph_tpu.plan_shards", "--selftest", "true"]),
 ]
 
 EXTRA_SELFTESTS = [

@@ -34,6 +34,14 @@ SECTIONS = [
       "build_comm_pattern", "compute_comm_map", "validate_plan",
       "plan_memory_usage", "interior_boundary_edge_counts",
       "pick_halo_impl", "resolve_halo_impl"]),
+    ("Sharded plan builds (cache format v8)", "dgraph_tpu.plan",
+     ["build_plan_shards", "build_edge_plan_sharded", "load_sharded_plan",
+      "assemble_plan", "shard_nbytes_estimate"]),
+    ("Plan shard IO & integrity", "dgraph_tpu.plan_shards",
+     ["PlanShardWriter", "PlanManifestError", "PlanShardError",
+      "PlanBuildMemoryExceeded", "read_manifest", "write_manifest",
+      "read_shard", "write_shard", "bad_shards", "payload_nbytes",
+      "resolve_memory_budget"]),
     ("Partitioning", "dgraph_tpu.partition", None),
     ("Rank-local ops", "dgraph_tpu.ops.local", None),
     ("Pallas kernels", "dgraph_tpu.ops.pallas_segment",

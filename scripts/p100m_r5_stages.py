@@ -8,7 +8,9 @@ an earlier phase:
 
   generate   power_law(111M, 14.5) -> cache/p100m/edges.npy (disk, 26 GB)
   partition  memmap edges -> multilevel_sampled(p=0.35) -> part.npy + cut
-  plan       memmap edges + part -> renumber -> cached plan build
+  plan       memmap edges + part -> renumber (to disk) -> streaming
+             per-rank plan shards (cache/p100m/plan_shards/, format v8:
+             resumable + memory-budgeted, dgraph_tpu.plan_shards)
 
 Usage: python scripts/p100m_r5_stages.py {generate|partition|plan}
 (scripts/p100m_r5.sh runs all three and commits the log.)
@@ -20,6 +22,7 @@ greedy_bfs full-scale record (logs/p100m_fullscale.jsonl).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import resource
@@ -116,50 +119,89 @@ def plan() -> None:
     import gc
 
     from dgraph_tpu import partition as pt
-    from dgraph_tpu.plan import plan_memory_usage
+    from dgraph_tpu.data.memmap import renumber_edges_chunked
 
     edges = np.load(EDGES, mmap_mode="r")
     part = np.load(PART)
+    # every derived artifact below (renumber resume marker, shard
+    # fingerprint) is bound to the partition CONTENT: a regenerated
+    # part.npy must invalidate both, or a resumed run would splice
+    # artifacts from two different partitions — shape checks and a
+    # constant name cannot tell them apart
+    part_sha = hashlib.sha256(np.ascontiguousarray(part).data).hexdigest()[:16]
     t0 = time.perf_counter()
     ren = pt.renumber_contiguous(part, WORLD)
     del part
     # renumber the memmapped edge list chunk-wise TO DISK: an in-RAM
     # [2, E] int64 copy (25.8 GB anon) on top of the plan core's own
-    # transients OOM-killed the first attempt at ~130 GB; the core reads
-    # src/dst in sequential passes, so file-backed pages reclaim under
-    # pressure instead of counting against the OOM killer
+    # transients OOM-killed the first attempt at ~130 GB
     E = edges.shape[1]
-    ne_path = os.path.join(CACHE, "new_edges.npy")
-    new_edges = np.lib.format.open_memmap(
-        ne_path, mode="w+", dtype=np.int64, shape=(2, E)
-    )
-    chunk = 1 << 26
-    for lo in range(0, E, chunk):
-        blk = np.asarray(edges[:, lo:lo + chunk])
-        new_edges[:, lo:lo + blk.shape[1]] = ren.perm[blk]
-    new_edges.flush()
+    ne_path = os.path.join(CACHE, f"new_edges{_SUF}.npy")
+    ne_ok = ne_path + ".ok"
+    try:
+        with open(ne_ok) as fh:
+            ne_marker = fh.read().strip()
+    except OSError:
+        ne_marker = ""
+    if os.path.exists(ne_path) and ne_marker == part_sha:
+        # the .ok marker (holding part.npy's content hash) is written
+        # only AFTER the tmp+rename completes, so a matching marker means
+        # a COMPLETED renumber of THIS partition: a resumed run after a
+        # mid-build SIGKILL skips re-streaming the ~26 GB copy.  A file
+        # without it — the pre-v8 in-place writer's full-size-but-partial
+        # file the r5 SIGKILL left behind, or a renumber of a stale
+        # part.npy — is re-renumbered, not adopted
+        new_edges = np.load(ne_path, mmap_mode="r")
+        assert new_edges.shape == (2, E), new_edges.shape
+    else:
+        tmp_path = ne_path + ".tmp.npy"
+        renumber_edges_chunked(edges, ren.perm, tmp_path)
+        os.replace(tmp_path, ne_path)
+        with open(ne_ok, "w") as fh:
+            fh.write(part_sha)
+        new_edges = np.load(ne_path, mmap_mode="r")
     partition_arr = ren.partition
-    del ren, new_edges
+    del ren
     gc.collect()
-    new_edges = np.load(ne_path, mmap_mode="r")
-    # no on-disk plan cache: the full-scale EdgePlan pickle is ~40+ GB
-    # (attempt 1's orphaned tmp pickle filled the disk and SIGBUS'd
-    # attempt 2's memmap writes); the logged build stats are the
-    # artifact, and part.npy lets any later run rebuild in ~1 h
-    from dgraph_tpu.plan import build_edge_plan
+    # sharded plan artifact (cache format v8, plan.build_plan_shards):
+    # per-rank shard pickles + checksummed manifest instead of the ~40+ GB
+    # monolithic EdgePlan pickle that killed r5 (attempt 1's orphaned tmp
+    # pickle filled the disk and SIGBUS'd attempt 2's memmap writes; the
+    # in-RAM [W, E_pad] stack OOM-killed attempt 3 at ~130 GB).  Each host
+    # later loads ONLY its ranks' shards
+    # (comm.multihost.process_local_plan_shards); a SIGKILL here resumes
+    # from the manifest on rerun, and DGRAPH_PLAN_MEMORY_BUDGET_MB turns
+    # an over-budget shard into a structured PlanBuildMemoryExceeded
+    # instead of an OOM kill
+    from dgraph_tpu.plan import build_plan_shards, shard_nbytes_estimate
 
-    plan_np, layout = build_edge_plan(
-        new_edges, partition_arr, world_size=WORLD, pad_multiple=128,
+    plan_dir = os.path.join(CACHE, f"plan_shards{_SUF}")
+    # write_layout=False: the O(E) layout sidecar pickles to ~25 GB here
+    # (and atomic_pickle_dump transiently doubles it on the disk that
+    # attempt 1's orphaned tmp pickle filled); nothing downstream of this
+    # stage consumes it — per-host loading skips it by design
+    # fingerprint defaults to a streaming content hash of
+    # (new_edges, partition) — a regenerated edge list or partition can
+    # never resume against the other's durable shards, even when counts
+    # and pads coincide (the hash streams the 26 GB memmap in windows,
+    # seconds against a multi-hour build)
+    manifest = build_plan_shards(
+        new_edges, partition_arr, out_dir=plan_dir, world_size=WORLD,
+        pad_multiple=128, write_layout=False,
     )
     os.remove(ne_path)
-    mem = plan_memory_usage(plan_np, feature_dim=128)
+    os.remove(ne_ok)
+    st = manifest["statics"]
+    shard_bytes = [int(e["bytes"]) for e in manifest["shards"].values()]
     _log({
         "phase": "plan_build", "edge_balance": EDGE_BALANCE, "part": PART,
         "wall_s": round(time.perf_counter() - t0, 1),
-        "e_pad": int(plan_np.e_pad), "s_pad": int(plan_np.halo.s_pad),
-        "halo_pairs": int(layout.halo_counts.sum()),
-        "halo_pair_fraction": round(float(layout.halo_counts.sum()) / max(E, 1), 4),
-        "plan_bytes": {k: int(v) for k, v in mem.items()},
+        "e_pad": int(st["e_pad"]), "s_pad": int(st["s_pad"]),
+        "plan_dir": plan_dir, "format_version": int(manifest["format_version"]),
+        "shards": len(shard_bytes),
+        "shard_bytes_max": max(shard_bytes),
+        "shard_bytes_total": sum(shard_bytes),
+        "shard_nbytes_estimate": int(shard_nbytes_estimate(st)),
     })
 
 

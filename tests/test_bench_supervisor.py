@@ -52,6 +52,13 @@ def test_backend_failure_emits_json_and_rc3():
     assert all(p["outcome"] in ("ok", "error", "hang") for p in rh["probes"])
     assert rh["wedge"] in ("init_failure", "init_wedge"), rh["wedge"]
     assert rh["schema"] == 1 and rh["host"]["hostname"]
+    # the probe loop runs under train.supervise: a wedged/unreachable
+    # backend lands a structured supervise_lineage (every attempt's
+    # outcome/rc/wall) in the round's JSON, not just free text
+    lin = out["supervise_lineage"]
+    assert lin["kind"] == "supervise_lineage"
+    assert lin["attempts"] and lin["final_exit_code"] != 0
+    assert lin["budget_exhausted"] or lin["gave_up"]
 
 
 @pytest.mark.slow
@@ -97,6 +104,7 @@ def test_wedged_probe_window_attaches_fallback_tiers():
     assert r.returncode == 3, (r.returncode, r.stdout, r.stderr[-500:])
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["value"] is None and "never initialized" in out["error"]
+    assert out["supervise_lineage"]["attempts"]  # tier 0: the probe lineage
     drift = out["schedule_drift"]
     assert drift["kind"] == "schedule_drift", drift
     assert "error" not in drift, drift

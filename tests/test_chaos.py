@@ -235,6 +235,85 @@ def test_supervisor_budget_exhaustion_and_backoff_growth():
     assert "restart budget" in lineage["run_health"]["error"]
 
 
+def test_supervisor_wall_budget_fail_fast():
+    # budget_s is the overall fail-fast wall budget (bench's
+    # --probe-budget-s runs through here): once elapsed + the next backoff
+    # would cross it, the supervisor stops restarting instead of burning
+    # its whole restart budget against a wedge
+    from dgraph_tpu.train.supervise import supervise
+
+    import time as _time
+
+    t0 = _time.monotonic()
+    lineage = supervise(
+        _pyc("import sys; sys.exit(17)"),
+        max_restarts=50, backoff_s=0.3, backoff_factor=1.0,
+        budget_s=1.0,
+    )
+    assert _time.monotonic() - t0 < 10
+    assert lineage["budget_exhausted"] and lineage["gave_up"]
+    assert lineage["final_exit_code"] == 17
+    assert len(lineage["attempts"]) < 50
+    assert "wall budget" in lineage["run_health"]["error"]
+
+
+def test_supervisor_budget_clamps_attempt_timeout():
+    # a child that would outlive the budget is killed when the remaining
+    # window expires, even with no attempt_timeout_s configured
+    from dgraph_tpu.train.supervise import supervise
+
+    import time as _time
+
+    t0 = _time.monotonic()
+    lineage = supervise(
+        _pyc("import time; time.sleep(60)"), max_restarts=3,
+        backoff_s=0.05, budget_s=1.5,
+    )
+    assert _time.monotonic() - t0 < 15
+    assert lineage["attempts"][0]["outcome"] == "timeout"
+    assert lineage["budget_exhausted"]
+
+
+def test_supervisor_stderr_capture_truncates_per_attempt(tmp_path):
+    # native-code deaths leave no Python-side error sidecar; the captured
+    # stderr tail is the only diagnostic — it must hold the LAST
+    # attempt's output only (a stale tail must not mislabel)
+    from dgraph_tpu.train.supervise import supervise
+
+    errf = tmp_path / "probe.stderr"
+    code = (
+        "import os, sys; a = os.environ['DGRAPH_CHAOS_ATTEMPT']; "
+        "print('attempt', a, 'diag', file=sys.stderr); "
+        "sys.exit(17 if a == '0' else 0)"
+    )
+    tails = []
+
+    def on_attempt(rec):
+        tails.append(errf.read_text().strip())
+
+    lineage = supervise(
+        _pyc(code), backoff_s=0.01, stderr_path=str(errf),
+        on_attempt=on_attempt,
+    )
+    assert lineage["final_exit_code"] == 0
+    assert tails == ["attempt 0 diag", "attempt 1 diag"]
+
+
+def test_supervisor_spawn_and_attempt_callbacks():
+    from dgraph_tpu.train.supervise import supervise
+
+    procs, recs = [], []
+    lineage = supervise(
+        _pyc("import os, sys; "
+             "sys.exit(17 if os.environ['DGRAPH_CHAOS_ATTEMPT'] == '0' "
+             "else 0)"),
+        backoff_s=0.01, on_spawn=procs.append, on_attempt=recs.append,
+    )
+    assert len(procs) == 2 and all(p.poll() is not None for p in procs)
+    assert recs == lineage["attempts"]
+    assert not lineage["budget_exhausted"]
+
+
 def test_supervisor_no_restart_on_crash_when_disabled():
     from dgraph_tpu.train.supervise import supervise
 

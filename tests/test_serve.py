@@ -570,13 +570,17 @@ def test_cached_edge_plan_rebuilds_truncated_pickle(tmp_path, caplog):
     part = np.array([0, 0, 1, 1])
     plan1, _ = cached_edge_plan(cache, edge_index, part, world_size=2,
                                 pad_multiple=1)
-    (pkl,) = glob.glob(os.path.join(cache, "plan_*.pkl"))
+    # v8 sharded artifact: plan_<key>/ holds per-rank shard pickles + a
+    # checksummed manifest; a torn shard rebuilds JUST that shard
+    (plan_dir,) = glob.glob(os.path.join(cache, "plan_*"))
+    pkl = os.path.join(plan_dir, "shard_0001.pkl")
     with open(pkl, "r+b") as f:
         f.truncate(7)  # torn write / killed mid-copy
     with caplog.at_level("WARNING", logger="dgraph_tpu.checkpoint"):
         plan2, _ = cached_edge_plan(cache, edge_index, part, world_size=2,
                                     pad_multiple=1)
-    assert any("rebuilding" in r.message for r in caplog.records)
+    assert any("rebuilding" in r.getMessage() and "shard 1" in r.getMessage()
+               for r in caplog.records)
     np.testing.assert_array_equal(plan1.src_index, plan2.src_index)
     np.testing.assert_array_equal(plan1.edge_mask, plan2.edge_mask)
     # the rebuild repaired the cache in place: third load is a clean hit
