@@ -156,6 +156,9 @@ JAX_FREE_TARGETS = (
     # shard/manifest integrity IO must run without a backend: the v8 plan
     # artifact is repaired/inspected on hosts where jax may be wedged
     "dgraph_tpu/plan_shards.py",
+    # liveness is the thing that must keep working while jax is wedged:
+    # heartbeats/polls/barriers/rendezvous never touch an accelerator API
+    "dgraph_tpu/comm/membership.py",
 )
 
 

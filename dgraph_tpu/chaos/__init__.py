@@ -29,14 +29,16 @@ Spec grammar (``DGRAPH_CHAOS`` env var, or :func:`arm`)::
     spec    := clause (';' clause)*
     clause  := point '=' action '@' index (':' param '=' value)*
     point   := one of KNOWN_POINTS (e.g. 'step', 'ckpt.save', 'grads')
-    action  := 'raise' | 'wedge' | 'sigterm' | 'poison'
+    action  := 'raise' | 'wedge' | 'sigterm' | 'poison' | 'delay'
     index   := non-negative int: the call index (or caller-supplied step
                index) at which the clause starts firing
     params  := count=N    fire for N consecutive indices (default 1)
                attempt=K  fire only on supervisor attempt K
-               sleep_s=S  wedge hold seconds (default 3600)
+               rank=K     fire only on group-supervisor rank K
+               sleep_s=S  wedge hold seconds (default 3600); for 'delay'
+                          the jitter ceiling (default 0.05)
                prob=P     fire with probability P at each index >= index
-               seed=S     RNG seed for prob clauses (default 0)
+               seed=S     RNG seed for prob/delay clauses (default 0)
 
 Examples::
 
@@ -51,7 +53,10 @@ TPU lease produces (the :class:`~dgraph_tpu.train.elastic.StepWatchdog`
 is what must catch it); ``sigterm`` delivers SIGTERM to this process (a
 simulated preemption, caught by :class:`~dgraph_tpu.train.elastic.
 PreemptionGuard`); ``poison`` makes :func:`fire` return True so the call
-site injects a non-finite value host-side (see :func:`poison_array`).
+site injects a non-finite value host-side (see :func:`poison_array`);
+``delay`` sleeps a seeded uniform jitter in ``[0, sleep_s)`` — the
+deterministic straggler, meant for ``comm.heartbeat`` so membership's
+straggler detection (not its loss path) is what must notice.
 
 Every RunHealth env snapshot records the active spec (or None) as its
 ``chaos`` field, so a perf artifact can never silently include a
@@ -73,6 +78,12 @@ ENV_VAR = "DGRAPH_CHAOS"
 # target one attempt (a wedge that re-fired on every resume would loop the
 # restart budget away)
 ATTEMPT_ENV_VAR = "DGRAPH_CHAOS_ATTEMPT"
+# the group supervisor's member ordinal (``supervise_group`` exports it to
+# each rank child) — shared group identity, not chaos-owned: workers read
+# it to know which plan shard/checkpoint block is theirs, and a chaos
+# clause's ``rank=K`` param matches against it so one spec can kill
+# exactly one member of a multi-rank launch
+RANK_ENV_VAR = "DGRAPH_RANK"
 
 # point name -> where it is consulted (documentation + typo guard: a spec
 # naming an unknown point is rejected at parse time, not silently inert)
@@ -90,11 +101,23 @@ KNOWN_POINTS = {
                         "rank's shard assembly (index=rank)",
     "plan.write": "plan_shards.py::write_shard, before each shard write",
     "plan.load": "plan_shards.py::read_shard, before each shard read",
+    # elastic world membership (comm/membership.py): heartbeat/lease and
+    # rendezvous faults — a 'delay' clause on comm.heartbeat is the
+    # deterministic straggler, a 'raise' on comm.rendezvous exercises the
+    # retrying-join backoff path, a 'sigterm' on step + rank=K is the
+    # rank-kill the shrink-to-fit acceptance test drives
+    "comm.heartbeat": "comm/membership.py::Membership.heartbeat, before "
+                      "each lease write (index=seq)",
+    "comm.rendezvous": "comm/membership.py::Membership.rendezvous, per "
+                       "join attempt (index=attempt)",
 }
 
-ACTIONS = ("raise", "wedge", "sigterm", "poison")
+ACTIONS = ("raise", "wedge", "sigterm", "poison", "delay")
 
 DEFAULT_WEDGE_SLEEP_S = 3600.0
+# 'delay' reuses sleep_s as the jitter CEILING; a wedge-scale default
+# would turn an injected straggler into an injected wedge
+DEFAULT_DELAY_SLEEP_S = 0.05
 
 
 class ChaosFault(RuntimeError):
@@ -126,12 +149,18 @@ class Clause:
     index: int
     count: int = 1
     attempt: Optional[int] = None
+    rank: Optional[int] = None
     sleep_s: float = DEFAULT_WEDGE_SLEEP_S
     prob: Optional[float] = None
     seed: int = 0
 
-    def matches(self, index: int, attempt: int, rng: Optional[random.Random]) -> bool:
+    def matches(
+        self, index: int, attempt: int, rng: Optional[random.Random],
+        rank: int = 0,
+    ) -> bool:
         if self.attempt is not None and attempt != self.attempt:
+            return False
+        if self.rank is not None and rank != self.rank:
             return False
         if self.prob is not None:
             # eligible from the start index on; one deterministic draw per
@@ -189,6 +218,8 @@ def parse_spec(spec: str) -> tuple:
                     kw["count"] = int(v)
                 elif k == "attempt":
                     kw["attempt"] = int(v)
+                elif k == "rank":
+                    kw["rank"] = int(v)
                 elif k == "sleep_s":
                     kw["sleep_s"] = float(v)
                 elif k == "prob":
@@ -198,8 +229,10 @@ def parse_spec(spec: str) -> tuple:
                 else:
                     raise ValueError(
                         f"chaos clause {raw!r}: unknown param {k!r} "
-                        "(count, attempt, sleep_s, prob, seed)"
+                        "(count, attempt, rank, sleep_s, prob, seed)"
                     )
+        if action == "delay" and "sleep_s" not in kw:
+            kw["sleep_s"] = DEFAULT_DELAY_SLEEP_S
         c = Clause(point=point, action=action, index=index, **kw)
         if c.count < 1:
             raise ValueError(f"chaos clause {raw!r}: count must be >= 1")
@@ -213,17 +246,19 @@ def parse_spec(spec: str) -> tuple:
 
 class _State:
     """An armed fault plan: clauses + per-point call counters + per-clause
-    RNGs (prob clauses). One per process; counters are thread-safe."""
+    RNGs (prob and delay clauses). One per process; counters are
+    thread-safe."""
 
-    def __init__(self, clauses: tuple, spec: str, attempt: int):
+    def __init__(self, clauses: tuple, spec: str, attempt: int, rank: int = 0):
         self.clauses = clauses
         self.spec = spec
         self.attempt = attempt
+        self.rank = rank
         self.counts: dict = {}
         self.rngs = {
             i: random.Random(c.seed)
             for i, c in enumerate(clauses)
-            if c.prob is not None
+            if c.prob is not None or c.action == "delay"
         }
 
 
@@ -239,22 +274,31 @@ def _resolve():
             spec = os.environ.get(ENV_VAR, "").strip()
             if spec:
                 att = os.environ.get(ATTEMPT_ENV_VAR, "").strip()
-                _STATE = _State(parse_spec(spec), spec, int(att) if att else 0)
+                rnk = os.environ.get(RANK_ENV_VAR, "").strip()
+                _STATE = _State(
+                    parse_spec(spec), spec,
+                    int(att) if att else 0, int(rnk) if rnk else 0,
+                )
             else:
                 _STATE = False
         return _STATE
 
 
-def arm(spec: str, attempt: Optional[int] = None) -> None:
+def arm(spec: str, attempt: Optional[int] = None,
+        rank: Optional[int] = None) -> None:
     """Programmatically arm a fault plan (tests, selftest). ``attempt``
-    defaults to ``DGRAPH_CHAOS_ATTEMPT`` (0 when unset)."""
+    defaults to ``DGRAPH_CHAOS_ATTEMPT`` (0 when unset), ``rank`` to
+    ``DGRAPH_RANK`` (0 when unset)."""
     global _STATE
     clauses = parse_spec(spec)
     if attempt is None:
         att = os.environ.get(ATTEMPT_ENV_VAR, "").strip()
         attempt = int(att) if att else 0
+    if rank is None:
+        rnk = os.environ.get(RANK_ENV_VAR, "").strip()
+        rank = int(rnk) if rnk else 0
     with _LOCK:
-        _STATE = _State(clauses, spec, attempt)
+        _STATE = _State(clauses, spec, attempt, rank)
 
 
 def disarm() -> None:
@@ -298,6 +342,7 @@ def snapshot() -> dict:
         "kind": "chaos",
         "spec": st.spec,
         "attempt": st.attempt,
+        "rank": st.rank,
         "counts": dict(st.counts),
     }
 
@@ -328,11 +373,18 @@ def fire(point: str, index: Optional[int] = None) -> bool:
         st.counts[point] = seen + 1
         idx = seen if index is None else int(index)
         fired = [
-            c for i, c in enumerate(st.clauses)
-            if c.point == point and c.matches(idx, st.attempt, st.rngs.get(i))
+            (i, c) for i, c in enumerate(st.clauses)
+            if c.point == point
+            and c.matches(idx, st.attempt, st.rngs.get(i), st.rank)
         ]
+        # delay jitter is drawn under the lock so concurrent fire()s keep
+        # a given seed replaying one deterministic schedule
+        delays = {
+            i: st.rngs[i].uniform(0.0, c.sleep_s)
+            for i, c in fired if c.action == "delay"
+        }
     poison = False
-    for c in fired:
+    for i, c in fired:
         if c.action == "poison":
             poison = True
         elif c.action == "raise":
@@ -340,6 +392,13 @@ def fire(point: str, index: Optional[int] = None) -> bool:
         elif c.action == "sigterm":
             print(f"[chaos] SIGTERM at {point} index {idx}", flush=True)
             os.kill(os.getpid(), signal.SIGTERM)
+        elif c.action == "delay":
+            print(
+                f"[chaos] delaying at {point} index {idx} for "
+                f"{delays[i]:.3f}s (injected straggler)",
+                flush=True,
+            )
+            time.sleep(delays[i])
         elif c.action == "wedge":
             print(
                 f"[chaos] wedging at {point} index {idx} for {c.sleep_s}s "

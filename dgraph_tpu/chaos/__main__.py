@@ -119,6 +119,74 @@ def _selftest() -> dict:
             f"plan.write fired at {plan_fired}, want [1]",
         )
 
+        # --- membership points (comm/membership.py): registered, parseable,
+        # firing like any host boundary ---
+        for pt in ("comm.heartbeat", "comm.rendezvous"):
+            _check(
+                failures, pt in chaos.KNOWN_POINTS,
+                f"membership point {pt!r} missing from KNOWN_POINTS",
+            )
+            (cl,) = chaos.parse_spec(f"{pt}=raise@1")
+            _check(
+                failures, cl.point == pt and cl.action == "raise",
+                f"membership point clause misparsed: {cl}",
+            )
+
+        # --- delay action: seeded sleep-jitter (straggler injection) ---
+        (cl,) = chaos.parse_spec("comm.heartbeat=delay@0:count=4:seed=3")
+        _check(
+            failures, cl.sleep_s == chaos.DEFAULT_DELAY_SLEEP_S,
+            f"delay default jitter ceiling {cl.sleep_s} != "
+            f"{chaos.DEFAULT_DELAY_SLEEP_S}",
+        )
+        (cl,) = chaos.parse_spec("comm.heartbeat=delay@0:sleep_s=0.2")
+        _check(failures, cl.sleep_s == 0.2, "delay sleep_s override lost")
+
+        class _SleepSpy:
+            def __init__(self):
+                self.slept = []
+
+            def sleep(self, s):
+                self.slept.append(s)
+
+            def __getattr__(self, name):  # monotonic etc. pass through
+                return getattr(time, name)
+
+        def delay_schedule():
+            spy = _SleepSpy()
+            orig_time = chaos.time
+            chaos.time = spy
+            try:
+                chaos.arm("comm.heartbeat=delay@0:count=8:sleep_s=0.5:seed=11")
+                for i in range(8):
+                    chaos.fire("comm.heartbeat", index=i)
+            finally:
+                chaos.time = orig_time
+            return spy.slept
+
+        a, b = delay_schedule(), delay_schedule()
+        _check(failures, len(a) == 8, f"delay fired {len(a)}/8 times")
+        _check(failures, a == b, f"delay jitter not deterministic: {a} vs {b}")
+        _check(
+            failures, all(0.0 <= s < 0.5 for s in a),
+            f"delay jitter out of [0, sleep_s): {a}",
+        )
+
+        # --- rank gating (the group supervisor's member ordinal) ---
+        chaos.arm("step=raise@1:rank=2", rank=0)
+        try:
+            for s in range(4):
+                chaos.fire("step", index=s)
+        except chaos.ChaosFault:
+            failures.append("rank=2 clause fired on rank 0")
+        chaos.arm("step=raise@1:rank=2", rank=2)
+        try:
+            for s in range(4):
+                chaos.fire("step", index=s)
+            failures.append("rank=2 clause never fired on rank 2")
+        except chaos.ChaosFault:
+            pass
+
         # --- attempt gating (the supervisor's restart ordinal) ---
         chaos.arm("step=raise@1:attempt=0", attempt=1)
         try:

@@ -1,0 +1,912 @@
+"""Elastic world membership: lease/heartbeat liveness, deadline barriers,
+retrying rendezvous, and structured rank-loss events.
+
+DGraph's full-graph training has no fault story: one lost rank in the
+NCCL/MPI/NVSHMEM halo exchange kills the whole run (PAPER.md L1/L2), and
+``comm/multihost.py`` inherits that — PR 8 made each host load only its
+plan shard, but nothing *detects* a dead host.  This module is the
+detection half of treating rank loss as a planned redistribution to a
+smaller world instead of a fatal crash ("Memory-efficient array
+redistribution through portable collective communication", PAPERS.md);
+the recovery half — shrink-to-fit re-planning and checkpoint resharding —
+lives in :mod:`dgraph_tpu.train.shrink`, and the restart policy in
+:func:`dgraph_tpu.train.supervise.supervise_group`.
+
+Design rules:
+
+- **Jax-free, lint-enforced, pure stdlib.** Liveness is exactly the thing
+  that must keep working while jax is wedged: heartbeats, polls, barriers
+  and rendezvous never touch an accelerator API (``analysis.lint``'s
+  ``jax-free-module`` rule covers this file), and the module imports only
+  stdlib plus the equally jax-free :mod:`dgraph_tpu.chaos` /
+  :mod:`dgraph_tpu.obs.spans` / :mod:`dgraph_tpu.obs.health`.
+- **Shared-directory transport.** A member is alive while its lease file
+  advances; the membership directory lives wherever the run's artifacts
+  do (local disk for single-host multi-process launches and tests, NFS /
+  FUSE-mounted object storage for real pods — the same deployment story
+  as the plan cache).  Writes are atomic (tmp + ``os.replace``), so a
+  reader never sees a torn lease.
+- **Logical-clock liveness, local deadlines.** Peers are judged by their
+  *sequence number* advancing within ``lease_s`` on the observer's own
+  monotonic clock — never by comparing wall clocks across hosts.  The
+  clock and sleep are injectable, so every deadline/backoff schedule is
+  testable without real sleeps.
+- **Deterministic under chaos.** The ``comm.heartbeat`` point fires
+  before each lease write (index = seq; a ``delay`` clause is the
+  injected straggler) and ``comm.rendezvous`` before each join attempt
+  (index = attempt; a ``raise`` clause exercises the retry/backoff
+  path).
+
+Events are structured (``.record()`` JSONL dicts, the ChaosFault/
+serve-errors discipline) and written through :mod:`dgraph_tpu.obs.spans`
+(one zero-duration span per event, joinable by trace id against the
+supervisor lineage) and, when a :class:`~dgraph_tpu.obs.health.RunHealth`
+is attached, ``RunHealth.record_event`` — so a degraded run's artifact
+alone tells the detection story.
+
+Interplay with the step watchdog: a *wedged* rank (hung dispatch, process
+alive) should exit 17 via :class:`~dgraph_tpu.train.elastic.StepWatchdog`
+and be collectively restarted at the same world size; only a rank whose
+*process* died stops heartbeating and becomes a :class:`RankLost`.  Keep
+``step_deadline_s`` (watchdog) **below** ``lease_s`` so a wedge is always
+classified as a wedge before peers give up on the rank.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+import dgraph_tpu.obs.spans as spans  # stdlib-only module (lint-enforced)
+from dgraph_tpu import chaos
+
+# a survivor that detected rank loss exits with this code after saving its
+# checkpoint; supervise_group treats it as "shrink the world and resume"
+# (the membership analog of train.elastic.WEDGED_EXIT_CODE == 17)
+RANK_LOST_EXIT_CODE = 19
+
+_MEMBER_PREFIX = "member_"
+_LEFT_PREFIX = "left_"
+_BARRIER_DIR = "barriers"
+
+
+# ---------------------------------------------------------------------------
+# events + errors (structured, JSONL-able)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RankLost:
+    """A peer's lease expired (or its process tombstoned abnormally):
+    its heartbeat sequence did not advance within ``lease_s`` on the
+    observer's clock."""
+
+    kind = "rank_lost"
+    rank: int
+    silent_for_s: float
+    last_seq: int
+    generation: int
+
+    def record(self) -> dict:
+        return {
+            "kind": self.kind,
+            "rank": self.rank,
+            "silent_for_s": round(self.silent_for_s, 3),
+            "last_seq": self.last_seq,
+            "generation": self.generation,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipChanged:
+    """The observer's alive-set changed (join, graceful leave, or loss)."""
+
+    kind = "membership_changed"
+    generation: int
+    alive: tuple
+    lost: tuple
+    left: tuple
+    world_size: int
+
+    def record(self) -> dict:
+        return {
+            "kind": self.kind,
+            "generation": self.generation,
+            "alive": list(self.alive),
+            "lost": list(self.lost),
+            "left": list(self.left),
+            "world_size": self.world_size,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class Straggler:
+    """A peer is late (silent past ``straggler_after_s``) but its lease
+    has not expired — report, don't evict. One event per episode; a
+    heartbeat that resumes re-arms the detector."""
+
+    kind = "straggler"
+    rank: int
+    silent_for_s: float
+    generation: int
+
+    def record(self) -> dict:
+        return {
+            "kind": self.kind,
+            "rank": self.rank,
+            "silent_for_s": round(self.silent_for_s, 3),
+            "generation": self.generation,
+        }
+
+
+class RankLostError(RuntimeError):
+    """Raised by callers (e.g. ``run_elastic(membership=...)``) once loss
+    is detected and the local checkpoint is durable — the process should
+    exit :data:`RANK_LOST_EXIT_CODE` so the group supervisor shrinks."""
+
+    def __init__(self, lost_ranks: tuple, events: tuple = ()):
+        super().__init__(
+            f"rank(s) {sorted(lost_ranks)} lost (lease expired); exit "
+            f"{RANK_LOST_EXIT_CODE} for shrink-to-fit restart"
+        )
+        self.lost_ranks = tuple(sorted(lost_ranks))
+        self.events = tuple(events)
+
+    def record(self) -> dict:
+        return {
+            "kind": "rank_lost_exit",
+            "lost_ranks": list(self.lost_ranks),
+            "exit_code": RANK_LOST_EXIT_CODE,
+            "events": [e.record() for e in self.events],
+        }
+
+
+class DeadlineExceeded(RuntimeError):
+    """A barrier or rendezvous deadline expired; carries who was missing
+    (and who straggled in late) so the operator log names the culprit."""
+
+    def __init__(self, what: str, deadline_s: float, missing: tuple,
+                 stragglers: tuple = ()):
+        super().__init__(
+            f"{what} deadline ({deadline_s:g}s) exceeded; missing ranks "
+            f"{sorted(missing)}"
+            + (f", stragglers {sorted(stragglers)}" if stragglers else "")
+        )
+        self.what = what
+        self.deadline_s = deadline_s
+        self.missing = tuple(sorted(missing))
+        self.stragglers = tuple(sorted(stragglers))
+
+    def record(self) -> dict:
+        return {
+            "kind": "deadline_exceeded",
+            "what": self.what,
+            "deadline_s": self.deadline_s,
+            "missing": list(self.missing),
+            "stragglers": list(self.stragglers),
+        }
+
+
+# ---------------------------------------------------------------------------
+# the membership core
+# ---------------------------------------------------------------------------
+
+
+def _atomic_write_json(path: str, obj: dict) -> None:
+    # no fsync on purpose: a lease file is liveness, not durability — a
+    # heartbeat lost to a host crash is exactly a missed heartbeat
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(obj, fh)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        # torn/vanished files read as "no heartbeat yet"; atomic writes
+        # make this transient
+        return None
+
+
+@dataclasses.dataclass
+class _PeerView:
+    """Observer-local liveness bookkeeping for one peer."""
+
+    seq: int = -1
+    last_change: float = 0.0  # observer monotonic time of last seq advance
+    seen: bool = False
+    lost: bool = False
+    left: bool = False
+    straggling: bool = False
+
+
+class Membership:
+    """One member's view of a fixed-id, shrinkable world.
+
+    Usage (one instance per rank process)::
+
+        mem = Membership(run_dir, rank=r, world_size=W, lease_s=5.0)
+        mem.rendezvous(deadline_s=60.0)       # wait for the full world
+        mem.start_heartbeats()                # lease tracks the PROCESS,
+        for step in ...:                      # not the step cadence
+            for ev in mem.poll():             # observe peers
+                ...                           # RankLost -> checkpoint, exit 19
+
+    ``generation`` names the world incarnation: after a shrink the
+    supervisor relaunches survivors with a fresh membership directory
+    (``shrink.membership_dir``), so stale generation-g leases can never
+    pollute generation g+1.
+
+    ``clock``/``sleep`` are injectable (tests drive every deadline with a
+    fake clock); both default to the monotonic wall.  ``health`` is an
+    optional :class:`~dgraph_tpu.obs.health.RunHealth` that receives every
+    event via ``record_event``.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        rank: int,
+        world_size: int,
+        lease_s: float = 5.0,
+        heartbeat_interval_s: Optional[float] = None,
+        straggler_after_s: Optional[float] = None,
+        generation: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        jitter_seed: int = 0,
+        health=None,
+    ):
+        if not 0 <= rank < world_size:
+            raise ValueError(f"rank {rank} not in [0, {world_size})")
+        if lease_s <= 0:
+            raise ValueError(f"lease_s must be > 0, got {lease_s}")
+        self.dir = directory
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.lease_s = float(lease_s)
+        self.heartbeat_interval_s = (
+            float(heartbeat_interval_s)
+            if heartbeat_interval_s is not None else self.lease_s / 4.0
+        )
+        self.straggler_after_s = (
+            float(straggler_after_s)
+            if straggler_after_s is not None else self.lease_s / 2.0
+        )
+        if not (0 < self.straggler_after_s <= self.lease_s):
+            raise ValueError(
+                f"straggler_after_s ({self.straggler_after_s}) must be in "
+                f"(0, lease_s={self.lease_s}]"
+            )
+        self.generation = int(generation)
+        self._clock = clock
+        self._sleep = sleep
+        # rank-keyed jitter: members retrying a rendezvous must not
+        # thundering-herd the shared directory in lockstep
+        self._rng = random.Random((jitter_seed << 16) ^ (self.rank + 1))
+        self._health = health
+        self._seq = 0
+        self._hb_lock = threading.Lock()
+        self._hb_stop: Optional[threading.Event] = None
+        self._hb_thread: Optional[threading.Thread] = None
+        self._view: dict = {}  # rank -> _PeerView
+        self.events: list = []  # every event record, in emit order
+        os.makedirs(self.dir, exist_ok=True)
+
+    # -- lease writes -------------------------------------------------------
+
+    def _member_path(self, rank: int) -> str:
+        return os.path.join(self.dir, f"{_MEMBER_PREFIX}{rank}.json")
+
+    def _left_path(self, rank: int) -> str:
+        return os.path.join(self.dir, f"{_LEFT_PREFIX}{rank}")
+
+    def heartbeat(self) -> int:
+        """Advance and publish this member's lease; returns the new seq.
+        The ``comm.heartbeat`` chaos point fires first (index = seq) — a
+        ``delay`` clause injects the straggle *before* the write, exactly
+        where a slow NFS round-trip would land.  Thread-safe (the
+        background :meth:`start_heartbeats` thread and the step loop may
+        both call it)."""
+        with self._hb_lock:
+            self._seq += 1
+            seq = self._seq
+            chaos.fire("comm.heartbeat", index=seq)
+            _atomic_write_json(
+                self._member_path(self.rank),
+                {
+                    "rank": self.rank,
+                    "seq": seq,
+                    "pid": os.getpid(),
+                    "generation": self.generation,
+                    "wall": time.time(),  # diagnostic only, never compared
+                },
+            )
+        return seq
+
+    def start_heartbeats(self, interval_s: Optional[float] = None) -> None:
+        """Background lease maintenance: a daemon thread heartbeats every
+        ``heartbeat_interval_s`` (default lease/4) so a slow host step —
+        a long orbax write, a loaded machine, a GC pause — can never read
+        as silence to peers.  Liveness must track the PROCESS, not the
+        step cadence: only a dead process (or a wedge that the watchdog
+        turns into exit 17 first) stops the thread.  ``poll()`` stays
+        caller-driven.  An injected :class:`~dgraph_tpu.chaos.ChaosFault`
+        inside the thread is swallowed — a raise clause on
+        ``comm.heartbeat`` means exactly "this heartbeat was lost".
+        Idempotent; pair with :meth:`stop_heartbeats`."""
+        if self._hb_thread is not None:
+            return
+        interval = (
+            float(interval_s) if interval_s is not None
+            else self.heartbeat_interval_s
+        )
+        self._hb_stop = threading.Event()
+
+        def _run():
+            while not self._hb_stop.wait(interval):
+                try:
+                    self.heartbeat()
+                except chaos.ChaosFault:
+                    pass  # an injected lost heartbeat IS the fault
+                except OSError:
+                    pass  # transient store hiccup: the lease just ages
+
+        self._hb_thread = threading.Thread(
+            target=_run, name=f"membership-hb-{self.rank}", daemon=True
+        )
+        self._hb_thread.start()
+
+    def stop_heartbeats(self) -> None:
+        if self._hb_thread is None:
+            return
+        self._hb_stop.set()
+        self._hb_thread.join(timeout=5.0)
+        self._hb_thread = None
+        self._hb_stop = None
+
+    def leave(self) -> None:
+        """Graceful departure: publish a tombstone so peers see a clean
+        ``left`` (a MembershipChanged without the lease wait) instead of a
+        loss."""
+        with open(self._left_path(self.rank), "w") as fh:
+            fh.write(str(self._seq))
+
+    # -- observation --------------------------------------------------------
+
+    def _read_members(self) -> dict:
+        out = {}
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith(_MEMBER_PREFIX) and name.endswith(".json"):
+                rec = _read_json(os.path.join(self.dir, name))
+                if rec is not None and rec.get("generation", 0) == self.generation:
+                    out[int(rec["rank"])] = rec
+        return out
+
+    def alive(self) -> tuple:
+        """Sorted ranks currently considered alive (self included)."""
+        live = {self.rank}
+        for r, v in self._view.items():
+            if v.seen and not v.lost and not v.left:
+                live.add(r)
+        return tuple(sorted(live))
+
+    def lost(self) -> tuple:
+        """Sorted ranks whose lease has expired."""
+        return tuple(sorted(r for r, v in self._view.items() if v.lost))
+
+    def poll(self) -> list:
+        """Read peers' leases and update the liveness view; returns the
+        NEW events this poll produced (:class:`RankLost`,
+        :class:`Straggler`, :class:`MembershipChanged`), each already
+        written through spans/health."""
+        now = self._clock()
+        members = self._read_members()
+        events: list = []
+        changed_lost: list = []
+        changed_left: list = []
+        joined = False
+        for r in range(self.world_size):
+            if r == self.rank:
+                continue
+            v = self._view.setdefault(r, _PeerView())
+            if v.lost or v.left:
+                continue  # terminal in this generation
+            if os.path.exists(self._left_path(r)):
+                v.left = True
+                changed_left.append(r)
+                continue
+            rec = members.get(r)
+            if rec is None:
+                # never heartbeated yet: pre-join, not lost (rendezvous
+                # owns the join deadline)
+                continue
+            seq = int(rec.get("seq", 0))
+            if not v.seen or seq != v.seq:
+                if not v.seen:
+                    joined = True
+                v.seq = seq
+                v.last_change = now
+                v.seen = True
+                if v.straggling:
+                    v.straggling = False  # episode over; re-arm detector
+                continue
+            age = now - v.last_change
+            if age > self.lease_s:
+                v.lost = True
+                ev = RankLost(
+                    rank=r, silent_for_s=age, last_seq=v.seq,
+                    generation=self.generation,
+                )
+                events.append(ev)
+                changed_lost.append(r)
+            elif age > self.straggler_after_s and not v.straggling:
+                v.straggling = True
+                events.append(Straggler(
+                    rank=r, silent_for_s=age, generation=self.generation,
+                ))
+        if joined or changed_lost or changed_left:
+            events.append(MembershipChanged(
+                generation=self.generation,
+                alive=self.alive(),
+                lost=self.lost(),
+                left=tuple(sorted(
+                    r for r, v in self._view.items() if v.left
+                )),
+                world_size=self.world_size,
+            ))
+        for ev in events:
+            self._emit(ev)
+        return events
+
+    def _emit(self, event) -> None:
+        rec = event.record()
+        self.events.append(rec)
+        # zero-duration span per event: joinable by trace id against the
+        # supervisor lineage (a no-op attribute read when tracing is off)
+        spans.span(
+            f"membership.{event.kind}", observer=self.rank, **rec
+        ).end()
+        if self._health is not None:
+            self._health.record_event(rec)
+
+    # -- collective waits ---------------------------------------------------
+
+    def rendezvous(
+        self,
+        deadline_s: float,
+        *,
+        expected: Optional[int] = None,
+        backoff_s: float = 0.05,
+        backoff_factor: float = 2.0,
+        backoff_max_s: float = 1.0,
+    ) -> tuple:
+        """Join the world and wait until ``expected`` (default: the full
+        ``world_size``) distinct members have published a lease; returns
+        the sorted roster.
+
+        Retrying: each attempt heartbeats, fires the ``comm.rendezvous``
+        chaos point (index = attempt; an injected :class:`~dgraph_tpu.
+        chaos.ChaosFault` counts as a failed attempt and is retried), and
+        re-reads the directory; between attempts the wait grows
+        ``backoff_s * backoff_factor**k`` capped at ``backoff_max_s``,
+        plus a rank-seeded jitter in ``[0, backoff_s)`` so members don't
+        re-scan in lockstep. Past ``deadline_s``: :class:`DeadlineExceeded`
+        naming the missing ranks.
+        """
+        expected = self.world_size if expected is None else int(expected)
+        t0 = self._clock()
+        attempt = 0
+        present: tuple = ()
+        with spans.span(
+            "membership.rendezvous", rank=self.rank, expected=expected,
+            generation=self.generation,
+        ) as rspan:
+            while True:
+                try:
+                    chaos.fire("comm.rendezvous", index=attempt)
+                    self.heartbeat()
+                    members = self._read_members()
+                    present = tuple(sorted(set(members) | {self.rank}))
+                    if len(present) >= expected:
+                        rspan.annotate(attempts=attempt + 1,
+                                       roster=list(present))
+                        self._emit(MembershipChanged(
+                            generation=self.generation,
+                            alive=present,
+                            lost=(),
+                            left=(),
+                            world_size=self.world_size,
+                        ))
+                        return present
+                except chaos.ChaosFault:
+                    pass  # injected transient: retry with backoff
+                delay = min(
+                    backoff_s * backoff_factor ** attempt, backoff_max_s
+                ) + self._rng.uniform(0.0, backoff_s)
+                if self._clock() - t0 + delay >= deadline_s:
+                    missing = tuple(
+                        r for r in range(self.world_size)
+                        if r not in present
+                    )
+                    err = DeadlineExceeded(
+                        "rendezvous", deadline_s, missing
+                    )
+                    rspan.end(error=str(err), attempts=attempt + 1)
+                    if self._health is not None:
+                        self._health.record_event(err.record())
+                    raise err
+                self._sleep(delay)
+                attempt += 1
+
+    def _barrier_dir(self, name: str) -> str:
+        return os.path.join(self.dir, _BARRIER_DIR, name.replace(os.sep, "_"))
+
+    def arrive(self, name: str) -> None:
+        """Publish this member's arrival at barrier ``name`` without
+        waiting (:meth:`barrier` = ``arrive`` + wait; split them when the
+        arrival should land before other work, e.g. before a long
+        checkpoint write that peers need not wait out)."""
+        bdir = self._barrier_dir(name)
+        os.makedirs(bdir, exist_ok=True)
+        with open(os.path.join(bdir, f"rank_{self.rank}"), "w") as fh:
+            fh.write(str(self._seq))
+
+    def barrier(
+        self,
+        name: str,
+        deadline_s: float,
+        *,
+        poll_interval_s: float = 0.05,
+    ) -> dict:
+        """Deadline barrier over the currently-alive ranks: publish own
+        arrival, wait until every alive rank arrived, fail fast otherwise.
+
+        Returns ``{"name", "arrived", "stragglers", "wall_s"}`` where
+        ``stragglers`` are ranks that arrived later than
+        ``straggler_after_s`` after this member (reported, not failed).
+        Raises :class:`DeadlineExceeded` when the deadline passes with
+        ranks missing, and :class:`RankLostError` immediately if a peer's
+        lease expires while we wait — a dead rank's barrier can never
+        complete, and burning the whole deadline to learn that wastes
+        exactly the detection latency membership exists to bound.
+        """
+        bdir = self._barrier_dir(name)
+        self.arrive(name)
+        t0 = self._clock()
+        stragglers: set = set()
+        arrived: set = set()
+        # lease writes + O(W) liveness polls are rate-limited to the
+        # heartbeat interval (arrival checks below stay at
+        # poll_interval_s — one listdir): a 50 ms full-poll cadence would
+        # hammer the shared store hardest exactly while waiting it out
+        hb_next = t0
+        with spans.span(
+            "membership.barrier", rank=self.rank, barrier=name,
+            generation=self.generation,
+        ) as bspan:
+            while True:
+                losses = []
+                if self._clock() >= hb_next:
+                    hb_next = self._clock() + self.heartbeat_interval_s
+                    self.heartbeat()
+                    losses = [
+                        e for e in self.poll() if isinstance(e, RankLost)
+                    ]
+                if losses:
+                    err = RankLostError(
+                        tuple(e.rank for e in losses), tuple(losses)
+                    )
+                    bspan.end(error=str(err))
+                    raise err
+                want = set(self.alive())
+                try:
+                    arrived = {
+                        int(f.split("_", 1)[1])
+                        for f in os.listdir(bdir)
+                        if f.startswith("rank_")
+                    }
+                except OSError:
+                    arrived = set()
+                now = self._clock()
+                if now - t0 > self.straggler_after_s:
+                    late = (want - arrived) - stragglers
+                    for r in sorted(late):
+                        stragglers.add(r)
+                        self._emit(Straggler(
+                            rank=r, silent_for_s=now - t0,
+                            generation=self.generation,
+                        ))
+                if want <= arrived:
+                    wall = now - t0
+                    bspan.annotate(
+                        arrived=sorted(arrived),
+                        stragglers=sorted(stragglers),
+                    )
+                    return {
+                        "name": name,
+                        "arrived": sorted(arrived),
+                        "stragglers": sorted(stragglers),
+                        "wall_s": round(wall, 3),
+                    }
+                if now - t0 + poll_interval_s >= deadline_s:
+                    err = DeadlineExceeded(
+                        f"barrier {name!r}", deadline_s,
+                        tuple(want - arrived), tuple(stragglers),
+                    )
+                    bspan.end(error=str(err))
+                    if self._health is not None:
+                        self._health.record_event(err.record())
+                    raise err
+                self._sleep(poll_interval_s)
+
+
+def read_roster(directory: str) -> dict:
+    """Read-only snapshot of a membership directory: every member's last
+    published lease, ACROSS generations (the operator's "who was here"
+    probe — a post-shrink dir's members all carry generation > 0, and a
+    diagnostic that filtered them out would go blank exactly when the
+    world is degraded).  Never creates or mutates anything; raises
+    FileNotFoundError for a missing directory (a typo'd path must not be
+    silently created as an empty world)."""
+    out = {}
+    for name in os.listdir(directory):  # propagates FileNotFoundError
+        if name.startswith(_MEMBER_PREFIX) and name.endswith(".json"):
+            rec = _read_json(os.path.join(directory, name))
+            if rec is not None:
+                rec = dict(rec)
+                rec["left"] = os.path.exists(
+                    os.path.join(directory, f"{_LEFT_PREFIX}{rec['rank']}")
+                )
+                out[int(rec["rank"])] = rec
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI: `python -m dgraph_tpu.comm.membership --selftest true`
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Config:
+    """Elastic world membership CLI (``--selftest`` is the compile-free
+    tier-1 smoke; the default shows a membership directory's roster)."""
+
+    selftest: bool = False
+    dir: str = ""  # roster mode: membership directory to inspect
+    indent: int = 0
+
+
+class _FakeClock:
+    """Deterministic monotonic clock; ``sleep`` advances it (no real
+    sleeps anywhere in the selftest)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, s: float) -> None:
+        self.t += s
+
+
+def _selftest() -> dict:  # noqa: C901 — one linear scenario script
+    import tempfile
+
+    failures: list = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    clock = _FakeClock()
+
+    def make(tmp, r, W, **kw):
+        return Membership(
+            tmp, rank=r, world_size=W, lease_s=2.0,
+            clock=clock, sleep=clock.sleep, **kw,
+        )
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # --- rendezvous: all three members join ---
+        ms = [make(tmp, r, 3) for r in range(3)]
+        for m in ms[:2]:
+            m.heartbeat()
+        roster = ms[2].rendezvous(deadline_s=10.0)
+        check(roster == (0, 1, 2), f"rendezvous roster {roster}")
+        for m in ms:
+            for _ in range(2):
+                m.heartbeat()
+        evs = ms[0].poll()
+        check(ms[0].alive() == (0, 1, 2), f"alive {ms[0].alive()}")
+        check(
+            any(e.kind == "membership_changed" for e in evs),
+            "join produced no membership_changed",
+        )
+
+        # --- straggler: rank 2 goes quiet past straggler_after_s ---
+        clock.sleep(1.2)  # > lease/2 (=1.0), < lease (=2.0)
+        for m in ms[:2]:
+            m.heartbeat()
+        evs = ms[0].poll()
+        stragglers = [e for e in evs if e.kind == "straggler"]
+        check(
+            [e.rank for e in stragglers] == [2],
+            f"straggler events {stragglers}",
+        )
+        check(ms[0].alive() == (0, 1, 2), "straggler wrongly evicted")
+        check(not [e for e in ms[0].poll() if e.kind == "straggler"],
+              "straggler re-reported within one episode")
+
+        # --- loss: the lease expires ---
+        clock.sleep(1.0)  # total silence 2.2 > lease
+        evs = ms[0].poll()
+        losses = [e for e in evs if e.kind == "rank_lost"]
+        check(
+            len(losses) == 1 and losses[0].rank == 2
+            and losses[0].silent_for_s > 2.0,
+            f"loss events {losses}",
+        )
+        check(ms[0].alive() == (0, 1), f"alive after loss {ms[0].alive()}")
+        check(ms[0].lost() == (2,), f"lost set {ms[0].lost()}")
+        changed = [e for e in evs if e.kind == "membership_changed"]
+        check(
+            changed and changed[-1].lost == (2,),
+            f"membership_changed after loss {changed}",
+        )
+        check(not ms[0].poll(), "loss re-reported on the next poll")
+        for rec in ms[0].events:
+            json.dumps(rec)  # every event JSONL-able
+
+        # --- graceful leave: tombstone, no lease wait ---
+        ms[1].heartbeat()
+        ms[1].leave()
+        evs = ms[0].poll()
+        check(
+            any(e.kind == "membership_changed" and 1 in e.left for e in evs),
+            f"leave not observed: {evs}",
+        )
+        check(ms[0].alive() == (0,), f"alive after leave {ms[0].alive()}")
+
+        # --- read_roster: read-only, cross-generation, left-flagged ---
+        roster = read_roster(tmp)
+        check(sorted(roster) == [0, 1, 2], f"roster ranks {sorted(roster)}")
+        check(roster[1]["left"] and not roster[0]["left"],
+              f"roster left flags {roster}")
+        try:
+            read_roster(tmp + "/no-such-dir")
+            failures.append("read_roster created/accepted a missing dir")
+        except FileNotFoundError:
+            pass
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # --- barrier: both arrive; stragglers reported, not failed ---
+        clock2 = _FakeClock()
+        a = Membership(tmp, rank=0, world_size=2, lease_s=50.0,
+                       clock=clock2, sleep=clock2.sleep)
+        b = Membership(tmp, rank=1, world_size=2, lease_s=50.0,
+                       clock=clock2, sleep=clock2.sleep)
+        a.heartbeat(), b.heartbeat()
+        a.poll(), b.poll()
+        a.arrive("epoch0")  # split arrival: a lands, then b's wait is instant
+        res_b = b.barrier("epoch0", deadline_s=60.0)
+        res_a = a.barrier("epoch0", deadline_s=60.0)
+        check(res_a["arrived"] == [0, 1], f"barrier arrivals {res_a}")
+        check(res_b["arrived"] == [0, 1], f"barrier arrivals {res_b}")
+
+        # --- barrier deadline: the absent rank is named ---
+        try:
+            a.barrier("epoch1", deadline_s=1.0)
+            failures.append("barrier with an absent rank did not time out")
+        except DeadlineExceeded as e:
+            check(e.missing == (1,), f"barrier missing {e.missing}")
+            json.dumps(e.record())
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # --- rendezvous deadline + retry-under-chaos ---
+        clock3 = _FakeClock()
+        solo = Membership(tmp, rank=0, world_size=2, lease_s=2.0,
+                          clock=clock3, sleep=clock3.sleep)
+        try:
+            solo.rendezvous(deadline_s=3.0)
+            failures.append("solo rendezvous for world 2 did not time out")
+        except DeadlineExceeded as e:
+            check(e.missing == (1,), f"rendezvous missing {e.missing}")
+        try:
+            chaos.arm("comm.rendezvous=raise@0:count=2")
+            other = Membership(tmp, rank=1, world_size=2, lease_s=2.0,
+                               clock=clock3, sleep=clock3.sleep)
+            other.heartbeat()
+            roster = solo.rendezvous(deadline_s=30.0)
+            check(roster == (0, 1),
+                  f"rendezvous under chaos roster {roster}")
+            check(chaos.call_count("comm.rendezvous") >= 3,
+                  "chaos raise clauses did not force retries")
+        finally:
+            chaos.reset()
+
+        # --- events flow into an attached RunHealth ---
+        from dgraph_tpu.obs.health import RunHealth
+
+        h = RunHealth.begin("membership.selftest")
+        clock4 = _FakeClock()
+        w = Membership(tmp + "/h", rank=0, world_size=2, lease_s=1.0,
+                       clock=clock4, sleep=clock4.sleep, health=h)
+        peer = Membership(tmp + "/h", rank=1, world_size=2, lease_s=1.0,
+                          clock=clock4, sleep=clock4.sleep)
+        peer.heartbeat()
+        w.poll()
+        clock4.sleep(1.5)
+        w.poll()
+        kinds = [e["kind"] for e in h.events]
+        check("rank_lost" in kinds and "membership_changed" in kinds,
+              f"health events {kinds}")
+        json.dumps(h.finish())
+
+    check(RANK_LOST_EXIT_CODE == 19, "RANK_LOST_EXIT_CODE drifted")
+    return {"kind": "membership_selftest", "failures": failures}
+
+
+def main(cfg: Config) -> dict:
+    from dgraph_tpu.obs.health import RunHealth
+
+    health = RunHealth.begin("membership.cli")
+    if cfg.selftest:
+        try:
+            out = _selftest()
+        except BaseException as e:  # every exit path carries RunHealth
+            rec = {
+                "kind": "membership_selftest",
+                "failures": [f"crashed: {type(e).__name__}: {e}"],
+                "run_health": health.finish(
+                    f"membership selftest crashed: {type(e).__name__}: {e}",
+                    wedge="stage_failure",
+                ),
+            }
+            print(json.dumps(rec, indent=cfg.indent or None))
+            raise
+        failures = out["failures"]
+        out["run_health"] = health.finish(
+            "; ".join(failures) if failures else None,
+            wedge="stage_failure" if failures else None,
+        )
+        print(json.dumps(out, indent=cfg.indent or None))
+        if failures:
+            raise SystemExit(
+                "membership selftest FAILED: " + "; ".join(failures)
+            )
+        return out
+    if not cfg.dir:
+        raise SystemExit(
+            "nothing to do: pass --selftest true, or --dir <membership "
+            "dir> for a roster snapshot"
+        )
+    # roster mode: a read-only snapshot of someone else's membership dir
+    out = {
+        "kind": "membership_roster",
+        "dir": cfg.dir,
+        "members": read_roster(cfg.dir),
+        "run_health": health.finish(),
+    }
+    print(json.dumps(out, indent=cfg.indent or None, default=str))
+    return out
+
+
+if __name__ == "__main__":
+    from dgraph_tpu.utils.cli import parse_config
+
+    main(parse_config(Config))

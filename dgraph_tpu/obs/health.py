@@ -153,6 +153,9 @@ class RunHealth:
     host: dict
     env: dict
     probes: list = dataclasses.field(default_factory=list)
+    # structured lifecycle events (membership rank_lost/membership_changed,
+    # shrink adoption, ...) — additive to schema 1, readers ignore it
+    events: list = dataclasses.field(default_factory=list)
     backend: Optional[dict] = None
     wedge: str = "none"
     error: Optional[str] = None
@@ -187,6 +190,16 @@ class RunHealth:
                 "detail": detail[-500:],
             }
         )
+
+    def record_event(self, rec: dict) -> None:
+        """Append one structured lifecycle event (a ``.record()`` dict —
+        membership's ``rank_lost``/``membership_changed``, shrink-to-fit
+        adoption, ...) so the health artifact alone tells the recovery
+        story. Bounded: after 200 events the oldest are dropped (a flapping
+        member must not grow the record without bound)."""
+        self.events.append(rec)
+        if len(self.events) > 200:
+            del self.events[: len(self.events) - 200]
 
     def snapshot_backend(self) -> Optional[dict]:
         """Best-effort jax backend/topology snapshot. Initializes the

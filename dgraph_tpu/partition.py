@@ -424,6 +424,80 @@ def partition_graph(
     return new_edges, ren
 
 
+def fold_partition(
+    partition: np.ndarray, world_size: int, lost_ranks
+) -> tuple[np.ndarray, dict]:
+    """Shrink-to-fit a partition: deterministically reassign the LOST
+    ranks' vertices to the survivors and compact surviving rank ids to
+    ``0..W'-1``.
+
+    This is the redistribution step of elastic rank-loss recovery
+    (:mod:`dgraph_tpu.train.shrink`): instead of re-partitioning from
+    scratch (which would move *every* vertex and invalidate locality the
+    tuner already priced), only the dead ranks' blocks move.  Allocation
+    is a waterfill — each survivor receives enough orphaned vertices to
+    equalize final loads (ties broken toward lower survivor ids), and the
+    orphans are handed out in vertex order as contiguous chunks per
+    survivor, preserving intra-block locality.  The whole fold is a pure
+    function of ``(partition, lost_ranks)``, so a crashed recovery that
+    reruns — or a fault-free run shrunk from the same inputs — lands the
+    identical partition (the bit-identical degraded-resume contract).
+
+    Returns ``(new_partition, survivor_map)`` where ``new_partition`` is
+    over the SAME vertex numbering as the input (run
+    :func:`renumber_contiguous` before building a plan) and
+    ``survivor_map`` maps old surviving rank id -> new compact id.
+    """
+    part = np.asarray(partition)
+    lost = sorted(set(int(r) for r in lost_ranks))
+    if not lost:
+        raise ValueError("fold_partition: lost_ranks is empty")
+    for r in lost:
+        if not 0 <= r < world_size:
+            raise ValueError(
+                f"fold_partition: lost rank {r} not in [0, {world_size})"
+            )
+    survivors = [r for r in range(world_size) if r not in lost]
+    if not survivors:
+        raise ValueError("fold_partition: no surviving ranks")
+    survivor_map = {old: new for new, old in enumerate(survivors)}
+    S = len(survivors)
+    counts = np.bincount(part, minlength=world_size).astype(np.int64)
+    loads = counts[survivors].copy()
+    orphans = np.flatnonzero(np.isin(part, lost))
+    L = orphans.size
+    # waterfill: smallest final max-load, deterministic. Find the lowest
+    # integer level T with sum(max(0, T - load)) >= L, allocate up to T,
+    # then trim the surplus from the HIGHEST-id survivors (stable rule).
+    lo, hi = int(loads.min()), int(loads.max()) + L
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if int(np.clip(mid - loads, 0, None).sum()) >= L:
+            hi = mid
+        else:
+            lo = mid + 1
+    alloc = np.clip(lo - loads, 0, None).astype(np.int64)
+    surplus = int(alloc.sum()) - L
+    for i in range(S - 1, -1, -1):
+        if surplus <= 0:
+            break
+        take = min(surplus, int(alloc[i]))
+        alloc[i] -= take
+        surplus -= take
+    new_part = np.empty_like(part, dtype=np.int32)
+    # survivors keep their vertices under compacted ids
+    remap = np.full(world_size, -1, dtype=np.int32)
+    for old, new in survivor_map.items():
+        remap[old] = new
+    keep = ~np.isin(part, lost)
+    new_part[keep] = remap[part[keep]]
+    # orphans: contiguous chunks per survivor, in vertex order
+    new_part[orphans] = np.repeat(
+        np.arange(S, dtype=np.int32), alloc
+    )
+    return new_part, survivor_map
+
+
 def edge_cut(edge_index: np.ndarray, partition: np.ndarray) -> float:
     """Fraction of edges crossing partitions (quality metric)."""
     src, dst = edge_index[0], edge_index[1]

@@ -1958,6 +1958,30 @@ def unshard_vertex_data(x: np.ndarray, counts: np.ndarray) -> np.ndarray:
     return np.concatenate([x[r, : counts[r]] for r in range(len(counts))], axis=0)
 
 
+def reshard_vertex_data(
+    x: np.ndarray,
+    old_counts: np.ndarray,
+    new_index: np.ndarray,
+    new_counts: np.ndarray,
+    new_n_pad: int,
+) -> np.ndarray:
+    """Redistribute ``[W, n_pad, ...]`` vertex-sharded data to a different
+    world: ``[W', n_pad', ...]``.
+
+    ``new_index`` maps new global vertex id -> old global vertex id (a
+    :class:`~dgraph_tpu.partition.Renumbering` ``inv`` — the composition
+    across generations when shrinking repeatedly), so rows follow their
+    vertex through an arbitrary renumbering.  This is the checkpoint-
+    reshard primitive of elastic rank-loss recovery
+    (:mod:`dgraph_tpu.train.shrink`): unshard by the old counts, reorder,
+    reshard by the new — the padded rows never leak between worlds.
+    """
+    global_x = unshard_vertex_data(np.asarray(x), old_counts)
+    return shard_vertex_data(
+        global_x[np.asarray(new_index)], new_counts, int(new_n_pad)
+    )
+
+
 def shard_edge_data(
     vals: np.ndarray, layout: EdgePlanLayout, e_pad: int
 ) -> np.ndarray:

@@ -180,19 +180,27 @@ def shard_filename(rank: int) -> str:
     return f"shard_{rank:04d}.pkl"
 
 
-def write_manifest(plan_dir: str, manifest: dict) -> None:
-    """Atomically write the manifest with a self-checksum (tmp + flush +
-    fsync + rename — the same torn-write discipline as
-    ``atomic_pickle_dump``)."""
-    manifest = dict(manifest)
-    manifest["manifest_sha256"] = _manifest_body_sha(manifest)
-    path = manifest_path(plan_dir)
+def atomic_write_json(path: str, obj: dict) -> None:
+    """Durable atomic JSON write (tmp + flush + fsync + rename — the same
+    torn-write discipline as ``atomic_pickle_dump``): readers never see a
+    truncated document, and a host crash cannot leave a durable-looking
+    empty file behind the rename.  Used for the plan manifest and for the
+    elastic-world adoption pointer (:mod:`dgraph_tpu.train.shrink`) —
+    anywhere "the last atomic rename wins" is the adoption semantics."""
     tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "w") as f:
-        json.dump(manifest, f, sort_keys=True, indent=1)
+        json.dump(obj, f, sort_keys=True, indent=1)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def write_manifest(plan_dir: str, manifest: dict) -> None:
+    """Atomically write the manifest with a self-checksum (see
+    :func:`atomic_write_json`)."""
+    manifest = dict(manifest)
+    manifest["manifest_sha256"] = _manifest_body_sha(manifest)
+    atomic_write_json(manifest_path(plan_dir), manifest)
 
 
 def read_manifest(plan_dir: str) -> dict:

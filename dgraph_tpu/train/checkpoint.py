@@ -62,7 +62,10 @@ def save_checkpoint(ckpt_dir: str, state: dict, step: int) -> None:
 
 
 def all_steps(ckpt_dir: str) -> list:
-    """Ascending list of checkpoint step numbers present in ``ckpt_dir``."""
+    """Ascending list of checkpoint step numbers present in ``ckpt_dir``.
+    Quarantined entries (``step_XXXXXXXX.corrupt``, see
+    :func:`restore_checkpoint`) are skipped — a step known bad is not a
+    resume candidate."""
     if not os.path.isdir(ckpt_dir):
         return []
     return sorted(
@@ -70,6 +73,21 @@ def all_steps(ckpt_dir: str) -> list:
         for d in os.listdir(ckpt_dir)
         if d.startswith("step_") and d.split("_")[1].isdigit()
     )
+
+
+def quarantined_steps(ckpt_dir: str) -> list:
+    """Ascending step numbers of quarantined (``.corrupt``-renamed)
+    checkpoint dirs — the operator's "what did the loader give up on"
+    probe. Rename a dir back to ``step_XXXXXXXX`` to retry it."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for d in os.listdir(ckpt_dir):
+        if d.startswith("step_") and d.endswith(".corrupt"):
+            num = d[len("step_"):-len(".corrupt")]
+            if num.isdigit():
+                out.append(int(num))
+    return sorted(out)
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
@@ -85,12 +103,19 @@ def restore_checkpoint(
 
     With ``step=None`` (the serving / resume path), a corrupt/truncated
     checkpoint (killed mid-save, torn copy) does not abort the restore:
-    the loader logs it and falls back to the next-older step, so the
-    process comes up on the newest *readable* state. Only when every
-    on-disk step fails does the last error propagate (returning None there
-    would silently restart from scratch). An explicitly requested ``step``
-    is strict: missing raises FileNotFoundError, unreadable raises the
-    underlying error — silently serving an older checkpoint than the one
+    the loader logs it, falls back to the next-older step, and — once an
+    older step restores successfully, proving the reader/template works —
+    **quarantines** the failed dirs (renamed to ``step_XXXXXXXX.corrupt``,
+    so the bad pickle is never silently re-read, and re-logged, on every
+    subsequent load; ``all_steps`` skips quarantined entries and
+    :func:`quarantined_steps` lists them). When every on-disk step fails
+    the last error propagates (returning None there would silently
+    restart from scratch) and NOTHING is quarantined — an all-steps
+    failure is likely systematic (template mismatch, broken orbax env),
+    and renaming every good checkpoint away would destroy the evidence.
+    An explicitly requested ``step`` is strict: missing raises
+    FileNotFoundError, unreadable raises the underlying error without
+    quarantining — silently serving an older checkpoint than the one
     NAMED would mislabel every downstream metric.
 
     The ``ckpt.read`` chaos point fires at entry (a deterministic stand-in
@@ -113,19 +138,61 @@ def restore_checkpoint(
     if not steps:
         return None
     last_err = None
+    failed = []  # (step, path, error) pending quarantine
     for s in reversed(steps):
         path = os.path.abspath(os.path.join(ckpt_dir, f"step_{s:08d}"))
         try:
             with ocp.PyTreeCheckpointer() as ckptr:
-                return ckptr.restore(path, item=template)
+                got = ckptr.restore(path, item=template)
         except Exception as e:  # noqa: BLE001 — any read/parse failure
             if step is not None:
                 raise
             last_err = e
+            failed.append((s, path, e))
             _logger.warning(
                 "checkpoint step_%08d unreadable (%s: %s); falling back to "
                 "next-older step", s, type(e).__name__, e,
             )
+            continue
+        # quarantine ONLY once an older step restored (that success proves
+        # the reader works — an all-steps failure is systematic and would
+        # otherwise rename every GOOD step away), and only when the
+        # failed step is unreadable even RAW (template=None): a raw
+        # restore that succeeds means the failure was a template/schema
+        # mismatch — e.g. a code rollback across a state-schema change —
+        # and the newest training progress must stay a resume candidate.
+        # The rename is what makes "log once" true: the entry leaves
+        # all_steps(), so no later load re-reads (or re-warns about) a
+        # step already known bad. Reversible by renaming back;
+        # best-effort (a read-only volume keeps fall-back-every-time).
+        for fs, fpath, fe in failed:
+            if template is not None:
+                try:
+                    with ocp.PyTreeCheckpointer() as ckptr:
+                        ckptr.restore(fpath)
+                    _logger.warning(
+                        "checkpoint step_%08d restores raw but not into "
+                        "the given template (%s: %s); NOT quarantining — "
+                        "likely a state-schema mismatch, not corruption",
+                        fs, type(fe).__name__, fe,
+                    )
+                    continue
+                except Exception:  # noqa: BLE001 — genuinely unreadable
+                    pass
+            qpath = fpath + ".corrupt"
+            try:
+                os.replace(fpath, qpath)
+                _logger.warning(
+                    "checkpoint step_%08d quarantined to %s (%s: %s)",
+                    fs, os.path.basename(qpath), type(fe).__name__, fe,
+                )
+            except OSError as qe:
+                _logger.warning(
+                    "checkpoint step_%08d quarantine failed: %s", fs, qe,
+                )
+        return got
+    # every step failed: likely systematic (bad template, broken orbax
+    # env) — quarantining here would destroy evidence wholesale
     raise last_err
 
 

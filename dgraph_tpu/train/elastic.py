@@ -170,6 +170,7 @@ def run_elastic(
     is_lead: bool = True,
     guard: Optional[PreemptionGuard] = None,
     rollback_on_abort: bool = True,
+    membership=None,
 ):
     """Drive ``train_step`` with preemption polling, periodic checkpoints,
     and an optional per-step wedge watchdog. Returns (state, last_step,
@@ -206,8 +207,24 @@ def run_elastic(
     entered by all hosts to serialize non-fully-addressable arrays (it
     coordinates lead-writes internally); gating to one process would
     deadlock or fail the save.
+
+    ``membership`` (a :class:`~dgraph_tpu.comm.membership.Membership`)
+    makes the loop a live member of an elastic world: background
+    heartbeats are started (``start_heartbeats``, idempotent — the lease
+    tracks the PROCESS, so a slow step or watchdog-suspended checkpoint
+    write never reads as silence), loss polls run at step boundaries
+    rate-limited to the heartbeat interval, and a detected peer loss
+    saves a checkpoint
+    (the survivor's contribution to the next consistent cut) and raises
+    :class:`~dgraph_tpu.comm.membership.RankLostError` — the caller
+    should exit :data:`~dgraph_tpu.comm.membership.RANK_LOST_EXIT_CODE`
+    so ``supervise_group`` runs the shrink-to-fit recovery
+    (:mod:`dgraph_tpu.train.shrink`).  Keep ``step_deadline_s`` below the
+    membership ``lease_s``: a *wedged* rank must exit 17 (collective
+    restart, same world) before its peers declare it lost.
     """
     from dgraph_tpu import chaos
+    from dgraph_tpu.comm.membership import RankLostError
     from dgraph_tpu.train.checkpoint import save_checkpoint
     from dgraph_tpu.train.guard import NonFiniteAbort
 
@@ -222,6 +239,17 @@ def run_elastic(
     preempted = False
     step = start_step
     last_saved = None
+    # membership liveness is PROCESS-scoped, not step-scoped: the
+    # background heartbeat thread (idempotent start) keeps the lease
+    # alive through long steps and watchdog-suspended checkpoint writes —
+    # a slow orbax save must never read as silence to peers. Loss POLLS
+    # stay at step boundaries, rate-limited to the heartbeat interval
+    # (a lease write + O(W) poll per step would hammer the shared
+    # membership dir at short step times, and detection latency is
+    # bounded by the lease anyway; 0.0 = check the first boundary).
+    if membership is not None:
+        membership.start_heartbeats()
+    mem_next = 0.0
 
     def _save(st, n):
         # a long orbax write is not a wedged device — pause the watchdog
@@ -275,6 +303,25 @@ def run_elastic(
                 raise
             if dog is not None:
                 dog.beat()
+            if membership is not None and time.monotonic() >= mem_next:
+                mem_next = (
+                    time.monotonic() + membership.heartbeat_interval_s
+                )
+                lost_events = [
+                    e for e in membership.poll() if e.kind == "rank_lost"
+                ]
+                if lost_events:
+                    # a survivor's job: land a durable checkpoint (its
+                    # block of the next consistent cut) and exit for the
+                    # group supervisor's shrink path
+                    if ckpt_dir and is_lead:
+                        _save(state, step + 1)
+                    err = RankLostError(
+                        tuple(e.rank for e in lost_events),
+                        tuple(lost_events),
+                    )
+                    run_span.annotate(rank_lost=[e.rank for e in lost_events])
+                    raise err
             done_now = guard.should_stop()
             periodic = (
                 checkpoint_every > 0 and (step + 1) % checkpoint_every == 0

@@ -51,9 +51,12 @@ if __name__ == "_dgraph_train_supervise":  # standalone (bench supervisor)
     spans = sys.modules["_dgraph_obs_spans"]
     WEDGED_EXIT_CODE = 17  # train.elastic.WEDGED_EXIT_CODE
     ATTEMPT_ENV_VAR = "DGRAPH_CHAOS_ATTEMPT"  # chaos.ATTEMPT_ENV_VAR
+    RANK_ENV_VAR = "DGRAPH_RANK"  # chaos.RANK_ENV_VAR
+    RANK_LOST_EXIT_CODE = 19  # comm.membership.RANK_LOST_EXIT_CODE
 else:
     import dgraph_tpu.obs.spans as spans  # jax-free (lint-enforced)
-    from dgraph_tpu.chaos import ATTEMPT_ENV_VAR
+    from dgraph_tpu.chaos import ATTEMPT_ENV_VAR, RANK_ENV_VAR
+    from dgraph_tpu.comm.membership import RANK_LOST_EXIT_CODE
     from dgraph_tpu.train.elastic import WEDGED_EXIT_CODE
 
 
@@ -62,7 +65,10 @@ class Config:
     """Train supervisor (``--cmd "python -m ..."`` is the child entrypoint;
     restarts on exit 17/crash with exponential backoff)."""
 
-    cmd: str = ""  # shell-style child command line (shlex-split)
+    cmd: str = ""  # shell-style child command line (shlex-split);
+    # with --ranks N, "{rank}"/"{world}" placeholders are substituted
+    ranks: int = 0  # 0 = single child; N > 0 = multi-rank group mode
+    rank_loss_grace_s: float = 30.0  # survivors' window to exit 19
     max_restarts: int = 8  # restart budget (attempts = budget + 1)
     backoff_s: float = 1.0  # first restart delay
     backoff_factor: float = 2.0
@@ -85,6 +91,41 @@ def _latest_step(ckpt_dir: str) -> Optional[int]:
     from dgraph_tpu.train.checkpoint import latest_step
 
     return latest_step(ckpt_dir)
+
+
+def _backoff_delay(attempt: int, backoff_s: float, backoff_factor: float,
+                   backoff_max_s: float) -> float:
+    """The ONE backoff schedule both supervisors run (exponential,
+    capped; attempt 0 never waits) — pinned by the fake-clock tests, and
+    shared so the single- and group-mode schedules cannot drift."""
+    if not attempt:
+        return 0.0
+    return min(backoff_s * backoff_factor ** (attempt - 1), backoff_max_s)
+
+
+def _final_error(rc, last_outcome: str, restarts: int, *, max_restarts: int,
+                 budget_s: float, budget_exhausted: bool, gave_up: bool,
+                 stopped_on_loss: bool = False, what: str = "child"):
+    """(error, wedge) summary shared by both supervisors' lineages."""
+    if rc == 0:
+        return None, None
+    if budget_exhausted:
+        exhausted = f"; wall budget ({budget_s:g}s) exhausted"
+    elif stopped_on_loss:
+        exhausted = "; stopped on rank loss (no shrink path)"
+    elif gave_up:
+        exhausted = f"; restart budget ({max_restarts}) exhausted"
+    else:
+        exhausted = ""
+    error = (
+        f"{what} exited {rc} ({last_outcome}) after {restarts} restart(s)"
+        + exhausted
+    )
+    wedge = (
+        "watchdog_timeout" if last_outcome in ("wedged", "timeout")
+        else "stage_failure"
+    )
+    return error, wedge
 
 
 def _append_jsonl(path: str, rec: dict) -> None:
@@ -113,6 +154,7 @@ def supervise(
     on_spawn=None,
     on_attempt=None,
     _sleep=time.sleep,
+    _clock=time.monotonic,
 ) -> dict:
     """Run ``argv`` under restart-and-resume supervision; returns the
     lineage record (``kind="supervise_lineage"``).
@@ -148,6 +190,10 @@ def supervise(
     to that file, truncated per attempt — so a child that dies in native
     code (segfault, PJRT abort) still leaves a diagnosable tail for the
     caller's failure record (bench's probe notes read it).
+
+    ``_sleep``/``_clock`` are injectable (monotonic) so tests pin the
+    exact backoff/cap/budget-clamp schedule with a fake clock — no real
+    sleeps in tier-1.
     """
     if "_dgraph_obs_health" in sys.modules:  # standalone (bench supervisor)
         RunHealth = sys.modules["_dgraph_obs_health"].RunHealth
@@ -164,20 +210,17 @@ def supervise(
     rc: Optional[int] = None
     gave_up = False
     budget_exhausted = False
-    t_start = time.monotonic()
+    t_start = _clock()
     for attempt in range(max_restarts + 1):
+        delay = _backoff_delay(attempt, backoff_s, backoff_factor,
+                               backoff_max_s)
         if attempt:
-            delay = min(
-                backoff_s * backoff_factor ** (attempt - 1), backoff_max_s
-            )
             if budget_s and (
-                time.monotonic() - t_start + delay >= budget_s
+                _clock() - t_start + delay >= budget_s
             ):
                 gave_up = budget_exhausted = True
                 break
             _sleep(delay)
-        else:
-            delay = 0.0
         resume_step = _latest_step(ckpt_dir)
         attempt_span = spans.span(
             "supervise.attempt", parent=run_span,
@@ -192,9 +235,9 @@ def supervise(
         # (attempt 0 always gets >= 1 s even under a tiny budget)
         timeout = attempt_timeout_s or 0.0
         if budget_s:
-            remaining = max(budget_s - (time.monotonic() - t_start), 1.0)
+            remaining = max(budget_s - (_clock() - t_start), 1.0)
             timeout = min(timeout, remaining) if timeout else remaining
-        t0 = time.monotonic()
+        t0 = _clock()
         timed_out = False
         # truncate-per-attempt so the file always holds the LAST
         # attempt's stderr — native crashes (segfault/PJRT abort) write
@@ -215,7 +258,7 @@ def supervise(
         finally:
             if stderr_fh is not None:
                 stderr_fh.close()
-        wall_s = time.monotonic() - t0
+        wall_s = _clock() - t0
         if rc == 0:
             outcome = "ok"
         elif timed_out:
@@ -255,24 +298,11 @@ def supervise(
         if attempt == max_restarts:
             gave_up = True
     restarts = len(attempts) - 1
-    if rc == 0:
-        error, wedge = None, None
-    else:
-        last = attempts[-1]["outcome"]
-        if budget_exhausted:
-            exhausted = f"; wall budget ({budget_s:g}s) exhausted"
-        elif gave_up:
-            exhausted = f"; restart budget ({max_restarts}) exhausted"
-        else:
-            exhausted = ""
-        error = (
-            f"child exited {rc} ({last}) after {restarts} restart(s)"
-            + exhausted
-        )
-        wedge = (
-            "watchdog_timeout" if last in ("wedged", "timeout")
-            else "stage_failure"
-        )
+    error, wedge = _final_error(
+        rc, attempts[-1]["outcome"] if attempts else "never_ran", restarts,
+        max_restarts=max_restarts, budget_s=budget_s,
+        budget_exhausted=budget_exhausted, gave_up=gave_up,
+    )
     run_span.end(error=error, restarts=restarts, final_exit_code=rc)
     return {
         "kind": "supervise_lineage",
@@ -290,12 +320,407 @@ def supervise(
     }
 
 
+def _rank_stderr_path(template: str, rank: int) -> str:
+    """Per-rank stderr file from a template: ``{rank}`` substituted when
+    present, else ``.rank<r>`` appended."""
+    if not template:
+        return ""
+    if "{rank}" in template:
+        return template.format(rank=rank)
+    return f"{template}.rank{rank}"
+
+
+def supervise_group(
+    argv_for_rank,
+    world_size: int,
+    *,
+    max_restarts: int = 8,
+    backoff_s: float = 1.0,
+    backoff_factor: float = 2.0,
+    backoff_max_s: float = 60.0,
+    restart_on_crash: bool = True,
+    attempt_timeout_s: float = 0.0,
+    budget_s: float = 0.0,
+    rank_loss_grace_s: float = 30.0,
+    min_world: int = 1,
+    on_rank_loss=None,
+    resume_step_fn=None,
+    ckpt_dir: str = "",
+    env: Optional[dict] = None,
+    rank_env: Optional[dict] = None,
+    stderr_path: str = "",
+    on_spawn=None,
+    on_attempt=None,
+    _sleep=time.sleep,
+    _clock=time.monotonic,
+    poll_interval_s: float = 0.05,
+) -> dict:
+    """Multi-rank supervision: one child per rank, one lineage per rank
+    child, collective restart semantics, and a shrink-to-fit path on rank
+    loss.  Returns the group lineage (``kind="supervise_group_lineage"``).
+
+    ``argv_for_rank(rank, world_size, attempt)`` builds each child's argv
+    — ranks are re-numbered ``0..W'-1`` after a shrink, so the callable is
+    re-consulted every attempt.  Each child inherits the environment plus
+    ``env`` plus its row of ``rank_env`` plus ``DGRAPH_CHAOS_ATTEMPT``,
+    ``DGRAPH_RANK`` and ``DGRAPH_WORLD_SIZE``.
+
+    Group restart policy, per attempt:
+
+    - every rank exits 0 — done.
+    - any rank exits ``17`` (:data:`WEDGED_EXIT_CODE`) or the attempt
+      times out — **collective restart**: the surviving children are
+      killed (outcome ``aborted``) and the whole group relaunches at the
+      SAME world size after backoff (a wedge is a device/lease problem,
+      not a membership change).
+    - a rank **crashes** (killed, segfault, any other nonzero): the group
+      is given ``rank_loss_grace_s`` for the survivors to detect the loss
+      through membership (:mod:`dgraph_tpu.comm.membership`), checkpoint,
+      and exit :data:`RANK_LOST_EXIT_CODE` (19).  If at least one survivor
+      did, the crashed ranks are declared LOST: ``on_rank_loss(lost,
+      world_size)`` runs the recovery (shrink-to-fit re-plan + checkpoint
+      reshard — :func:`dgraph_tpu.train.shrink.shrink_world`) and returns
+      the new world size; the group relaunches at ``W - len(lost)`` with
+      ranks renumbered.  With no 19 exits it is a plain crash: restart at
+      the same world size while ``restart_on_crash`` holds.
+    - ``on_rank_loss=None`` (or a shrink below ``min_world``) stops the
+      group with the rank-loss exit code instead of shrinking.
+
+    ``budget_s`` is the SHARED fail-fast wall budget across every rank and
+    attempt (the single-mode contract); per-attempt timeouts are clamped
+    to the remaining window.  ``stderr_path`` is a per-rank template
+    (``{rank}`` substituted, else ``.rank<r>`` appended), truncated per
+    attempt like the single-rank capture.
+
+    Watchdog/lease ordering matters: children should keep their
+    ``step_deadline_s`` *below* the membership ``lease_s`` so a wedged
+    rank exits 17 (collective restart, same world) before its peers give
+    up on it and trigger a shrink.
+    """
+    if "_dgraph_obs_health" in sys.modules:  # standalone (bench supervisor)
+        RunHealth = sys.modules["_dgraph_obs_health"].RunHealth
+    else:
+        from dgraph_tpu.obs.health import RunHealth
+
+    run_span = spans.span("train.supervise_group", world_size=world_size)
+    health = RunHealth.begin("train.supervisor.group")
+    W = int(world_size)
+    attempts: list = []
+    shrinks: list = []
+    rc: Optional[int] = None
+    gave_up = False
+    budget_exhausted = False
+    stopped_on_loss = False
+    t_start = _clock()
+    for attempt in range(max_restarts + 1):
+        delay = _backoff_delay(attempt, backoff_s, backoff_factor,
+                               backoff_max_s)
+        if attempt:
+            if budget_s and (_clock() - t_start + delay >= budget_s):
+                gave_up = budget_exhausted = True
+                break
+            _sleep(delay)
+        resume_step = (
+            resume_step_fn(attempt, W) if resume_step_fn is not None
+            else _latest_step(ckpt_dir)
+        )
+        attempt_span = spans.span(
+            "supervise.group_attempt", parent=run_span,
+            attempt=attempt, world_size=W, resume_step=resume_step,
+        )
+        timeout = attempt_timeout_s or 0.0
+        if budget_s:
+            remaining = max(budget_s - (_clock() - t_start), 1.0)
+            timeout = min(timeout, remaining) if timeout else remaining
+        t0 = _clock()
+        procs: dict = {}
+        stderr_fhs: dict = {}
+        rank_spans: dict = {}
+        try:
+            try:
+                for r in range(W):
+                    child_env = {
+                        **os.environ, **(env or {}),
+                        **((rank_env or {}).get(r) or {}),
+                        ATTEMPT_ENV_VAR: str(attempt),
+                        RANK_ENV_VAR: str(r),
+                        "DGRAPH_WORLD_SIZE": str(W),
+                        **spans.child_env(parent=attempt_span),
+                    }
+                    sp = _rank_stderr_path(stderr_path, r)
+                    fh = open(sp, "wb") if sp else None
+                    stderr_fhs[r] = fh
+                    rank_spans[r] = spans.span(
+                        "supervise.rank", parent=attempt_span,
+                        rank=r, attempt=attempt,
+                    )
+                    procs[r] = subprocess.Popen(
+                        argv_for_rank(r, W, attempt), env=child_env,
+                        stderr=fh,
+                    )
+                    if on_spawn is not None:
+                        on_spawn(procs[r])
+            except BaseException:
+                # a failed rank-K spawn must not orphan ranks 0..K-1: no
+                # supervisor would ever wait or kill them
+                for p in procs.values():
+                    try:
+                        p.kill()
+                        p.wait()
+                    except OSError:
+                        pass
+                raise
+            # --- monitor: collective-restart on wedge, grace on crash ---
+            exit_codes: dict = {}
+            ends: dict = {}
+            aborted: set = set()
+            timed_out = False
+            grace_deadline = None
+            while len(exit_codes) < W:
+                for r, p in procs.items():
+                    if r in exit_codes:
+                        continue
+                    code = p.poll()
+                    if code is not None:
+                        exit_codes[r] = code
+                        ends[r] = _clock()
+                now = _clock()
+                live = [r for r in procs if r not in exit_codes]
+                if not live:
+                    break
+                if timeout and now - t0 > timeout:
+                    timed_out = True
+                elif any(
+                    c == WEDGED_EXIT_CODE for c in exit_codes.values()
+                ):
+                    # one wedged rank restarts the WHOLE group: its peers
+                    # would only burn their halo-exchange deadlines —
+                    # fall through to the kill below
+                    pass
+                else:
+                    # a CRASH starts the grace window: survivors get time
+                    # to DETECT the loss (membership lease), checkpoint,
+                    # and exit 19.  19-reporters themselves start it only
+                    # as a QUORUM of what's left — that covers the zombie
+                    # (a rank whose process is alive but whose lease
+                    # expired: every peer exits 19 and waiting on the
+                    # zombie forever would hang the shrink they asked
+                    # for) without letting ONE spurious detection abort a
+                    # healthy still-training group
+                    crashed_now = [
+                        r for r, c in exit_codes.items()
+                        if c not in (0, WEDGED_EXIT_CODE,
+                                     RANK_LOST_EXIT_CODE)
+                    ]
+                    reporters = [
+                        r for r, c in exit_codes.items()
+                        if c == RANK_LOST_EXIT_CODE
+                    ]
+                    if grace_deadline is None and (
+                        crashed_now
+                        or (reporters and len(reporters) >= len(live))
+                    ):
+                        grace_deadline = now + rank_loss_grace_s
+                    if grace_deadline is None or now < grace_deadline:
+                        _sleep(poll_interval_s)
+                        continue
+                # timeout / wedge / grace expiry: kill the stragglers
+                for r in live:
+                    procs[r].kill()
+                    procs[r].wait()
+                    exit_codes[r] = procs[r].returncode
+                    ends[r] = _clock()
+                    aborted.add(r)
+                break
+        finally:
+            for fh in stderr_fhs.values():
+                if fh is not None:
+                    fh.close()
+        # --- classify ranks + the group ---
+        rank_recs = []
+        for r in range(W):
+            code = exit_codes.get(r)
+            if r in aborted:
+                outcome = "timeout" if timed_out else "aborted"
+            elif code == 0:
+                outcome = "ok"
+            elif code == WEDGED_EXIT_CODE:
+                outcome = "wedged"
+            elif code == RANK_LOST_EXIT_CODE:
+                outcome = "rank_lost"
+            else:
+                outcome = "crashed"
+            rank_spans[r].end(
+                error=None if code == 0 else f"exit {code} ({outcome})",
+                exit_code=code, outcome=outcome,
+            )
+            rank_recs.append({
+                "rank": r,
+                "exit_code": code,
+                "outcome": outcome,
+                "wall_s": round(ends.get(r, _clock()) - t0, 3),
+                "span_id": rank_spans[r].span_id,
+            })
+        outcomes = {rec["outcome"] for rec in rank_recs}
+        # the LOST set: ranks that crashed, plus ranks the grace expiry
+        # killed (zombies whose peers declared them lost and exited 19 —
+        # their processes outlived their leases)
+        dead = sorted(
+            rec["rank"] for rec in rank_recs
+            if rec["outcome"] in ("crashed", "aborted")
+        )
+        if outcomes == {"ok"}:
+            group_outcome, rc = "ok", 0
+        elif timed_out:
+            group_outcome = "timeout"
+            rc = WEDGED_EXIT_CODE
+        elif "wedged" in outcomes:
+            group_outcome = "wedged"
+            rc = WEDGED_EXIT_CODE
+        elif "rank_lost" in outcomes and dead:
+            group_outcome = "rank_lost"
+            rc = RANK_LOST_EXIT_CODE
+        elif dead:
+            group_outcome = "crashed"
+            # the CRASHING rank's code, not a grace-expiry kill signal —
+            # the operator (and anything keying on exit status) needs the
+            # real failure, and aborted survivors only died because of it
+            crashed_codes = [
+                rec["exit_code"] for rec in rank_recs
+                if rec["outcome"] == "crashed"
+            ]
+            rc = crashed_codes[0] if crashed_codes else next(
+                rec["exit_code"] for rec in rank_recs
+                if rec["exit_code"] not in (0, None)
+            )
+        else:  # only ok + rank_lost reporters, nobody actually died
+            group_outcome = "crashed"
+            rc = RANK_LOST_EXIT_CODE
+        attempt_rec = {
+            "attempt": attempt,
+            "world_size": W,
+            "outcome": group_outcome,
+            "backoff_s": round(delay, 3),
+            "wall_s": round(_clock() - t0, 3),
+            "resume_step": resume_step,
+            "ranks": rank_recs,
+            "shrink": None,
+            "span_id": attempt_span.span_id,
+        }
+        attempt_span.end(
+            error=None if rc == 0 else f"group {group_outcome}",
+            outcome=group_outcome,
+        )
+        attempts.append(attempt_rec)
+        health.record_probe(
+            attempt, attempt_rec["wall_s"],
+            "ok" if rc == 0 else (
+                "hang" if group_outcome in ("wedged", "timeout") else "error"
+            ),
+            f"group {group_outcome} at W={W}, resumed from {resume_step}",
+        )
+        if on_attempt is not None:
+            on_attempt(attempt_rec)
+        if rc == 0:
+            break
+        if group_outcome == "rank_lost":
+            if attempt == max_restarts:
+                # no restart budget left to LAUNCH a shrunk world: don't
+                # burn the re-plan/reshard on a result nobody would run
+                gave_up = True
+                break
+            new_world = W - len(dead)
+            if on_rank_loss is None or new_world < min_world:
+                stopped_on_loss = True
+                break
+            shrink_rec = {
+                "attempt": attempt,
+                "lost": dead,
+                "old_world": W,
+                "new_world": new_world,
+            }
+            with spans.span(
+                "supervise.shrink", parent=run_span, **shrink_rec
+            ):
+                got = on_rank_loss(dead, W)
+            if got is not None:
+                new_world = int(got)
+            if new_world < min_world:
+                stopped_on_loss = True
+                break
+            shrink_rec["new_world"] = new_world
+            attempt_rec["shrink"] = shrink_rec
+            shrinks.append(shrink_rec)
+            health.record_event({"kind": "shrink", **shrink_rec})
+            W = new_world
+            continue
+        if group_outcome == "crashed" and not restart_on_crash:
+            break
+        if attempt == max_restarts:
+            gave_up = True
+    restarts = len(attempts) - 1
+    error, wedge = _final_error(
+        rc, attempts[-1]["outcome"] if attempts else "never_ran", restarts,
+        max_restarts=max_restarts, budget_s=budget_s,
+        budget_exhausted=budget_exhausted, gave_up=gave_up,
+        stopped_on_loss=stopped_on_loss, what="group",
+    )
+    run_span.end(
+        error=error, restarts=restarts, final_exit_code=rc,
+        final_world_size=W,
+    )
+    return {
+        "kind": "supervise_group_lineage",
+        "world_size": int(world_size),
+        "final_world_size": W,
+        "trace_id": spans.current_trace_id(),
+        "attempts": attempts,
+        "restarts": restarts,
+        "shrinks": shrinks,
+        "final_exit_code": rc,
+        "gave_up": gave_up,
+        "budget_exhausted": budget_exhausted,
+        "stopped_on_rank_loss": stopped_on_loss,
+        "final_step": _latest_step(ckpt_dir),
+        "run_health": health.finish(error, wedge),
+    }
+
+
 def main(cfg: Config) -> dict:
     if not cfg.cmd.strip():
         raise SystemExit(
             'supervise: --cmd is required, e.g. --cmd "python -m '
             'experiments.ogb_gcn --epochs 100"'
         )
+    if cfg.ranks > 0:
+        # substitute ONLY the documented placeholders (str.format would
+        # crash on any other literal brace in the command line — JSON
+        # args, glob patterns — and the same cmd must behave identically
+        # with and without --ranks)
+        def argv_for_rank(r, w, _attempt):
+            return shlex.split(
+                cfg.cmd.replace("{rank}", str(r)).replace("{world}", str(w))
+            )
+
+        lineage = supervise_group(
+            argv_for_rank,
+            cfg.ranks,
+            max_restarts=cfg.max_restarts,
+            backoff_s=cfg.backoff_s,
+            backoff_factor=cfg.backoff_factor,
+            backoff_max_s=cfg.backoff_max_s,
+            restart_on_crash=cfg.restart_on_crash,
+            attempt_timeout_s=cfg.attempt_timeout_s,
+            budget_s=cfg.budget_s,
+            rank_loss_grace_s=cfg.rank_loss_grace_s,
+            stderr_path=cfg.stderr_path,
+            ckpt_dir=cfg.ckpt_dir,
+        )
+        _append_jsonl(cfg.log_path, lineage)
+        print(json.dumps(lineage, indent=cfg.indent or None), flush=True)
+        if lineage["final_exit_code"] != 0:
+            sys.exit(lineage["final_exit_code"])
+        return lineage
     argv = shlex.split(cfg.cmd)
     lineage = supervise(
         argv,

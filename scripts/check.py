@@ -34,6 +34,11 @@ PASSES = [
     # writer resume, memory budget, chaos points — pure numpy+stdlib IO
     ("plan-shards-selftest",
      [sys.executable, "-m", "dgraph_tpu.plan_shards", "--selftest", "true"]),
+    # elastic world membership: heartbeat/lease liveness, barriers,
+    # rendezvous, straggler/loss events — pure stdlib, fake-clock driven
+    ("membership-selftest",
+     [sys.executable, "-m", "dgraph_tpu.comm.membership",
+      "--selftest", "true"]),
 ]
 
 EXTRA_SELFTESTS = [

@@ -29,6 +29,10 @@ SECTIONS = [
     ("Differentiable collectives", "dgraph_tpu.comm.collectives", None),
     ("Device mesh", "dgraph_tpu.comm.mesh", None),
     ("Multi-host launch", "dgraph_tpu.comm.multihost", None),
+    ("Elastic world membership", "dgraph_tpu.comm.membership",
+     ["Membership", "RankLost", "MembershipChanged", "Straggler",
+      "RankLostError", "DeadlineExceeded", "read_roster",
+      "RANK_LOST_EXIT_CODE"]),
     ("Communication plans", "dgraph_tpu.plan",
      ["CommPattern", "EdgePlan", "OverlapSpec", "build_edge_plan",
       "build_comm_pattern", "compute_comm_map", "validate_plan",
@@ -36,12 +40,12 @@ SECTIONS = [
       "pick_halo_impl", "resolve_halo_impl"]),
     ("Sharded plan builds (cache format v8)", "dgraph_tpu.plan",
      ["build_plan_shards", "build_edge_plan_sharded", "load_sharded_plan",
-      "assemble_plan", "shard_nbytes_estimate"]),
+      "assemble_plan", "shard_nbytes_estimate", "reshard_vertex_data"]),
     ("Plan shard IO & integrity", "dgraph_tpu.plan_shards",
      ["PlanShardWriter", "PlanManifestError", "PlanShardError",
       "PlanBuildMemoryExceeded", "read_manifest", "write_manifest",
-      "read_shard", "write_shard", "bad_shards", "payload_nbytes",
-      "resolve_memory_budget"]),
+      "atomic_write_json", "read_shard", "write_shard", "bad_shards",
+      "payload_nbytes", "resolve_memory_budget"]),
     ("Partitioning", "dgraph_tpu.partition", None),
     ("Rank-local ops", "dgraph_tpu.ops.local", None),
     ("Pallas kernels", "dgraph_tpu.ops.pallas_segment",
@@ -56,7 +60,11 @@ SECTIONS = [
     ("Data layer", "dgraph_tpu.data", None),
     ("Training utilities", "dgraph_tpu.train.loop", None),
     ("Elastic / failure handling", "dgraph_tpu.train.elastic", None),
-    ("Train supervisor", "dgraph_tpu.train.supervise", ["supervise"]),
+    ("Train supervisor", "dgraph_tpu.train.supervise",
+     ["supervise", "supervise_group"]),
+    ("Shrink-to-fit recovery", "dgraph_tpu.train.shrink",
+     ["init_world", "shrink_world", "read_world", "write_world",
+      "ShrinkError"]),
     ("Non-finite step guard", "dgraph_tpu.train.guard",
      ["NonFiniteMonitor", "NonFiniteAbort"]),
     ("Chaos fault injection", "dgraph_tpu.chaos",

@@ -140,6 +140,40 @@ def test_unknown_point_rejected_when_armed():
         chaos.fire("not.a.point")
 
 
+def test_rank_gating():
+    # the group supervisor exports DGRAPH_RANK; a clause pinned to rank 2
+    # must not fire on any other member (the one-member-kill spec the
+    # shrink acceptance test arms group-wide)
+    chaos.arm("step=raise@1:rank=2", rank=0)
+    for s in range(4):
+        chaos.fire("step", index=s)  # no raise
+    chaos.arm("step=raise@1:rank=2", rank=2)
+    with pytest.raises(ChaosFault):
+        for s in range(4):
+            chaos.fire("step", index=s)
+
+
+def test_delay_action_seeded_jitter(monkeypatch):
+    # 'delay' sleeps a seeded uniform jitter in [0, sleep_s): the injected
+    # straggler. Deterministic per seed; sleep_s defaults small (a
+    # wedge-scale default would be a wedge, not a straggle)
+    (cl,) = chaos.parse_spec("comm.heartbeat=delay@0")
+    assert cl.sleep_s == chaos.DEFAULT_DELAY_SLEEP_S
+
+    def schedule():
+        slept = []
+        monkeypatch.setattr(chaos.time, "sleep", slept.append)
+        chaos.arm("comm.heartbeat=delay@0:count=6:sleep_s=0.4:seed=9")
+        for i in range(6):
+            assert chaos.fire("comm.heartbeat", index=i) is False
+        return slept
+
+    a, b = schedule(), schedule()
+    assert len(a) == 6 and a == b
+    assert all(0.0 <= s < 0.4 for s in a)
+    assert len(set(a)) > 1  # jitter, not a constant
+
+
 # ---------------------------------------------------------------------------
 # fault-point wiring (fire-at-entry: no orbax/plan work needed)
 # ---------------------------------------------------------------------------
@@ -325,6 +359,59 @@ def test_supervisor_no_restart_on_crash_when_disabled():
     assert not lineage["gave_up"]  # stopped by policy, not budget
 
 
+# membership's selftest fake clock (sleep advances it) — one
+# implementation shared by every fake-clock test in the repo
+from dgraph_tpu.comm.membership import _FakeClock  # noqa: E402
+
+
+def test_supervisor_exact_backoff_schedule_fake_clock():
+    # the EXACT backoff/cap/budget-clamp schedule, no real sleeps: the
+    # injectable monotonic clock advances only through the injected sleep
+    from dgraph_tpu.train.supervise import supervise
+
+    fc = _FakeClock()
+    sleeps = []
+
+    def fsleep(s):
+        sleeps.append(s)
+        fc.sleep(s)
+
+    lineage = supervise(
+        _pyc("import sys; sys.exit(7)"),
+        max_restarts=10, backoff_s=1.0, backoff_factor=2.0,
+        backoff_max_s=8.0, budget_s=12.0, _sleep=fsleep, _clock=fc,
+    )
+    # exponential 1, 2, 4; the next delay (8) would land at 7 + 8 = 15
+    # >= 12, so the budget stops the restart loop BEFORE sleeping it
+    assert sleeps == [1.0, 2.0, 4.0]
+    assert len(lineage["attempts"]) == 4
+    assert lineage["budget_exhausted"] and lineage["gave_up"]
+    assert [a["backoff_s"] for a in lineage["attempts"]] == [
+        0.0, 1.0, 2.0, 4.0
+    ]
+    assert "wall budget" in lineage["run_health"]["error"]
+
+
+def test_supervisor_backoff_cap_fake_clock():
+    from dgraph_tpu.train.supervise import supervise
+
+    fc = _FakeClock()
+    sleeps = []
+
+    def fsleep(s):
+        sleeps.append(s)
+        fc.sleep(s)
+
+    lineage = supervise(
+        _pyc("import sys; sys.exit(7)"),
+        max_restarts=5, backoff_s=1.0, backoff_factor=3.0,
+        backoff_max_s=5.0, _sleep=fsleep, _clock=fc,
+    )
+    # exponential then clamped at the cap, full restart budget spent
+    assert sleeps == [1.0, 3.0, 5.0, 5.0, 5.0]
+    assert lineage["gave_up"] and not lineage["budget_exhausted"]
+
+
 def test_supervisor_attempt_timeout_counts_as_wedge():
     from dgraph_tpu.train.supervise import supervise
 
@@ -338,6 +425,190 @@ def test_supervisor_attempt_timeout_counts_as_wedge():
     assert [a["outcome"] for a in lineage["attempts"]] == ["timeout", "ok"]
     assert lineage["attempts"][0]["exit_code"] == 17
     assert lineage["final_exit_code"] == 0
+
+
+# ---------------------------------------------------------------------------
+# multi-rank group supervision (python -c children; the full rank-kill
+# acceptance path lives in tests/test_shrink.py)
+# ---------------------------------------------------------------------------
+
+
+def test_group_all_ok_single_attempt():
+    from dgraph_tpu.train.supervise import supervise_group
+
+    lineage = supervise_group(
+        lambda r, w, a: _pyc("import sys; sys.exit(0)"), 3, backoff_s=0.01,
+    )
+    assert lineage["kind"] == "supervise_group_lineage"
+    assert lineage["final_exit_code"] == 0 and lineage["restarts"] == 0
+    assert lineage["final_world_size"] == 3
+    a0 = lineage["attempts"][0]
+    assert [x["outcome"] for x in a0["ranks"]] == ["ok"] * 3
+    assert [x["rank"] for x in a0["ranks"]] == [0, 1, 2]
+    json.dumps(lineage)
+
+
+def test_group_wedge_triggers_collective_restart():
+    # one rank exits 17: the still-running peers are killed (aborted) and
+    # the WHOLE group relaunches at the same world size
+    from dgraph_tpu.train.supervise import supervise_group
+
+    code = (
+        "import os, sys, time; "
+        "a = os.environ['DGRAPH_CHAOS_ATTEMPT']; "
+        "r = os.environ['DGRAPH_RANK']; "
+        "assert os.environ['DGRAPH_WORLD_SIZE'] == '3'; "
+        "sys.exit(17) if (a == '0' and r == '1') else "
+        "(time.sleep(30) if a == '0' else None); sys.exit(0)"
+    )
+    lineage = supervise_group(
+        lambda r, w, a: _pyc(code), 3, backoff_s=0.01,
+    )
+    assert lineage["final_exit_code"] == 0 and lineage["restarts"] == 1
+    a0, a1 = lineage["attempts"]
+    assert a0["outcome"] == "wedged" and a0["world_size"] == 3
+    outs = {x["rank"]: x["outcome"] for x in a0["ranks"]}
+    assert outs[1] == "wedged"
+    assert set(outs.values()) == {"wedged", "aborted"}
+    assert a1["outcome"] == "ok" and a1["world_size"] == 3
+    assert lineage["shrinks"] == []
+
+
+def test_group_rank_loss_shrinks_via_callback():
+    # a crashed rank plus a 19-exiting survivor is a rank loss: the
+    # recovery callback picks the new world and the group relaunches
+    # renumbered 0..W'-1
+    from dgraph_tpu.train.supervise import supervise_group
+
+    code = (
+        "import os, sys, time; "
+        "a = os.environ['DGRAPH_CHAOS_ATTEMPT']; "
+        "r = os.environ['DGRAPH_RANK']; "
+        "w = os.environ['DGRAPH_WORLD_SIZE']\n"
+        "if a == '0' and r == '2': sys.exit(70)\n"
+        "if a == '0': time.sleep(0.2); sys.exit(19)\n"
+        "assert w == '2', w\n"
+        "sys.exit(0)"
+    )
+    calls = []
+
+    def on_rank_loss(lost, world):
+        calls.append((lost, world))
+        return world - len(lost)
+
+    lineage = supervise_group(
+        lambda r, w, a: _pyc(code), 3, backoff_s=0.01,
+        rank_loss_grace_s=30.0, on_rank_loss=on_rank_loss,
+    )
+    assert lineage["final_exit_code"] == 0, lineage
+    assert calls == [([2], 3)]
+    assert lineage["final_world_size"] == 2
+    assert lineage["shrinks"] == [
+        {"attempt": 0, "lost": [2], "old_world": 3, "new_world": 2}
+    ]
+    a0 = lineage["attempts"][0]
+    outs = {x["rank"]: x["outcome"] for x in a0["ranks"]}
+    assert outs[2] == "crashed"
+    assert outs[0] == outs[1] == "rank_lost"
+    assert a0["shrink"]["new_world"] == 2
+
+
+def test_group_zombie_rank_killed_after_reporter_quorum():
+    # the zombie case: a rank's PROCESS outlives its lease (dead
+    # heartbeat thread, storage partition) so it never exits — once every
+    # remaining peer has exited 19, the grace window starts and the
+    # zombie is killed and counted LOST (waiting on it forever would hang
+    # the shrink its peers asked for)
+    from dgraph_tpu.train.supervise import supervise_group
+
+    code = (
+        "import os, sys, time; r = os.environ['DGRAPH_RANK']; "
+        "a = os.environ['DGRAPH_CHAOS_ATTEMPT']\n"
+        "if a == '0' and r == '1': time.sleep(120)\n"
+        "if a == '0': sys.exit(19)\n"
+        "sys.exit(0)"
+    )
+    losses = []
+    lineage = supervise_group(
+        lambda r, w, a: _pyc(code), 2, backoff_s=0.01,
+        rank_loss_grace_s=1.0,
+        on_rank_loss=lambda lost, w: (losses.append((lost, w)),
+                                      w - len(lost))[-1],
+    )
+    assert lineage["final_exit_code"] == 0, lineage
+    assert losses == [([1], 2)]
+    a0 = lineage["attempts"][0]
+    outs = {x["rank"]: x["outcome"] for x in a0["ranks"]}
+    assert outs[0] == "rank_lost" and outs[1] == "aborted"
+    assert lineage["final_world_size"] == 1
+
+
+def test_group_rank_loss_without_shrink_path_stops():
+    from dgraph_tpu.train.supervise import supervise_group
+
+    code = (
+        "import os, sys, time; r = os.environ['DGRAPH_RANK']\n"
+        "if r == '1': sys.exit(70)\n"
+        "time.sleep(0.2); sys.exit(19)\n"
+    )
+    lineage = supervise_group(
+        lambda r, w, a: _pyc(code), 2, backoff_s=0.01,
+        rank_loss_grace_s=30.0,
+    )
+    assert lineage["final_exit_code"] == 19
+    assert lineage["stopped_on_rank_loss"] and not lineage["gave_up"]
+    assert "stopped on rank loss" in lineage["run_health"]["error"]
+
+
+def test_group_plain_crash_restarts_same_world():
+    # no survivor exits 19: a crash is a crash — same-world restart
+    from dgraph_tpu.train.supervise import supervise_group
+
+    code = (
+        "import os, sys; "
+        "sys.exit(7 if os.environ['DGRAPH_CHAOS_ATTEMPT'] == '0' else 0)"
+    )
+    lineage = supervise_group(
+        lambda r, w, a: _pyc(code), 2, backoff_s=0.01,
+        rank_loss_grace_s=0.2,
+        on_rank_loss=lambda lost, w: pytest.fail("not a rank loss"),
+    )
+    assert lineage["final_exit_code"] == 0 and lineage["restarts"] == 1
+    assert lineage["attempts"][0]["outcome"] == "crashed"
+    assert lineage["final_world_size"] == 2
+
+
+def test_group_per_rank_stderr_capture(tmp_path):
+    from dgraph_tpu.train.supervise import supervise_group
+
+    code = (
+        "import os, sys; "
+        "print('rank', os.environ['DGRAPH_RANK'], 'diag', file=sys.stderr)"
+    )
+    lineage = supervise_group(
+        lambda r, w, a: _pyc(code), 2, backoff_s=0.01,
+        stderr_path=str(tmp_path / "probe.stderr"),
+    )
+    assert lineage["final_exit_code"] == 0
+    for r in range(2):
+        tail = (tmp_path / f"probe.stderr.rank{r}").read_text().strip()
+        assert tail == f"rank {r} diag"
+
+
+def test_group_shared_wall_budget_fail_fast():
+    import time as _time
+
+    from dgraph_tpu.train.supervise import supervise_group
+
+    t0 = _time.monotonic()
+    lineage = supervise_group(
+        lambda r, w, a: _pyc("import sys; sys.exit(17)"), 2,
+        max_restarts=50, backoff_s=0.3, backoff_factor=1.0, budget_s=1.0,
+    )
+    assert _time.monotonic() - t0 < 15
+    assert lineage["budget_exhausted"] and lineage["gave_up"]
+    assert lineage["final_exit_code"] == 17
+    assert len(lineage["attempts"]) < 50
 
 
 # ---------------------------------------------------------------------------

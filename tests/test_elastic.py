@@ -195,3 +195,34 @@ def test_run_elastic_abort_propagates_without_checkpoint(tmp_path):
             step, {"w": jnp.zeros(2)}, start_step=0, num_steps=4,
             ckpt_dir=None, guard=PreemptionGuard(signals=()),
         )
+
+
+def test_run_elastic_membership_loss_checkpoints_and_raises(tmp_path):
+    # the elastic-membership hook: a peer's lease expires mid-run -> the
+    # loop lands a durable checkpoint (its block of the next consistent
+    # cut) and raises RankLostError so the worker can exit 19 for the
+    # group supervisor's shrink path (tests/test_shrink.py drives the
+    # full pipeline; this pins just the loop contract)
+    from dgraph_tpu.comm.membership import Membership, RankLostError
+
+    mdir = str(tmp_path / "members")
+    me = Membership(mdir, rank=0, world_size=2, lease_s=0.3)
+    peer = Membership(mdir, rank=1, world_size=2, lease_s=0.3)
+    peer.heartbeat()  # joins once, then falls silent forever
+    me.poll()
+
+    def step(state):
+        time.sleep(0.05)
+        return {"w": state["w"] + 1.0}
+
+    ckpt = str(tmp_path / "ck")
+    with pytest.raises(RankLostError) as ei:
+        run_elastic(
+            step, {"w": np.zeros(2)}, start_step=0, num_steps=500,
+            ckpt_dir=ckpt, guard=PreemptionGuard(signals=()),
+            membership=me,
+        )
+    assert ei.value.lost_ranks == (1,)
+    # the checkpoint landed BEFORE the raise: resume has a consistent cut
+    saved = latest_step(ckpt)
+    assert saved is not None and 1 <= saved < 500

@@ -1,0 +1,282 @@
+"""Elastic world membership (dgraph_tpu/comm/membership.py): lease/
+heartbeat liveness, straggler vs loss classification, deadline barriers,
+retrying rendezvous with capped backoff, event plumbing through
+spans/health, and the chaos points. Everything here is pure host code
+driven by a FAKE clock — zero XLA compiles, zero real sleeps beyond the
+sub-second chaos delay check."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dgraph_tpu import chaos
+from dgraph_tpu.comm import membership as ms
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    yield
+    chaos.reset()
+
+
+# the ONE fake monotonic clock (sleep advances it) — membership ships it
+# for its own selftest; reusing it keeps the semantics from forking
+FakeClock = ms._FakeClock
+
+
+def make_world(tmp_path, world_size, lease_s=2.0, **kw):
+    clock = FakeClock()
+    members = [
+        ms.Membership(
+            str(tmp_path), rank=r, world_size=world_size, lease_s=lease_s,
+            clock=clock, sleep=clock.sleep, **kw,
+        )
+        for r in range(world_size)
+    ]
+    return clock, members
+
+
+# ---------------------------------------------------------------------------
+# liveness: heartbeat / straggler / loss / leave
+# ---------------------------------------------------------------------------
+
+
+def test_all_alive_after_heartbeats(tmp_path):
+    clock, (a, b, c) = make_world(tmp_path, 3)
+    for m in (a, b, c):
+        m.heartbeat()
+    evs = a.poll()
+    assert a.alive() == (0, 1, 2)
+    assert any(e.kind == "membership_changed" for e in evs)
+    # a second quiet poll is event-free
+    assert a.poll() == []
+
+
+def test_straggler_then_loss_classification(tmp_path):
+    clock, (a, b) = make_world(tmp_path, 2, lease_s=2.0)
+    a.heartbeat(), b.heartbeat()
+    a.poll()
+    # silent past straggler_after_s (lease/2 = 1.0) but inside the lease:
+    # reported once, not evicted
+    clock.sleep(1.2)
+    a.heartbeat()
+    evs = a.poll()
+    stragglers = [e for e in evs if e.kind == "straggler"]
+    assert [e.rank for e in stragglers] == [1]
+    assert a.alive() == (0, 1)
+    assert [e for e in a.poll() if e.kind == "straggler"] == []  # one/episode
+    # a resumed heartbeat closes the episode and re-arms the detector
+    b.heartbeat()
+    assert a.poll() == []
+    clock.sleep(1.2)
+    assert [e.rank for e in a.poll() if e.kind == "straggler"] == [1]
+    # ...and full silence past the lease is a loss
+    clock.sleep(1.0)
+    evs = a.poll()
+    losses = [e for e in evs if e.kind == "rank_lost"]
+    assert len(losses) == 1 and losses[0].rank == 1
+    assert losses[0].silent_for_s > 2.0
+    assert a.alive() == (0,) and a.lost() == (1,)
+    changed = [e for e in evs if e.kind == "membership_changed"]
+    assert changed[-1].lost == (1,) and changed[-1].world_size == 2
+    # terminal: never re-reported
+    assert a.poll() == []
+    for rec in a.events:
+        json.dumps(rec)
+
+
+def test_graceful_leave_is_not_a_loss(tmp_path):
+    clock, (a, b) = make_world(tmp_path, 2)
+    a.heartbeat(), b.heartbeat()
+    a.poll()
+    b.leave()
+    evs = a.poll()
+    assert a.alive() == (0,) and a.lost() == ()
+    assert any(e.kind == "membership_changed" and 1 in e.left for e in evs)
+    assert not any(e.kind == "rank_lost" for e in evs)
+
+
+def test_never_seen_rank_is_pending_not_lost(tmp_path):
+    clock, members = make_world(tmp_path, 3)
+    a = members[0]
+    a.heartbeat()
+    clock.sleep(100.0)
+    assert a.poll() == []  # join deadlines belong to rendezvous
+    assert a.alive() == (0,) and a.lost() == ()
+
+
+def test_events_flow_into_health(tmp_path):
+    from dgraph_tpu.obs.health import RunHealth
+
+    clock = FakeClock()
+    h = RunHealth.begin("t")
+    a = ms.Membership(str(tmp_path), rank=0, world_size=2, lease_s=1.0,
+                      clock=clock, sleep=clock.sleep, health=h)
+    b = ms.Membership(str(tmp_path), rank=1, world_size=2, lease_s=1.0,
+                      clock=clock, sleep=clock.sleep)
+    b.heartbeat()
+    a.poll()
+    clock.sleep(1.5)
+    a.poll()
+    kinds = [e["kind"] for e in h.events]
+    assert "rank_lost" in kinds and "membership_changed" in kinds
+    json.dumps(h.finish())
+
+
+def test_background_heartbeats_survive_slow_steps(tmp_path):
+    # REAL clock on purpose: the thread is what keeps a live-but-slow
+    # member (one step stretched far past the lease by a long orbax
+    # write or a loaded machine) from reading as dead to its peers —
+    # liveness tracks the process, not the step cadence
+    import time as _time
+
+    a = ms.Membership(str(tmp_path), rank=0, world_size=2, lease_s=0.4)
+    b = ms.Membership(str(tmp_path), rank=1, world_size=2, lease_s=0.4)
+    a.heartbeat(), b.heartbeat()
+    a.poll()
+    b.start_heartbeats(interval_s=0.05)
+    b.start_heartbeats()  # idempotent
+    try:
+        deadline = _time.monotonic() + 1.2  # 3x the lease, b never "steps"
+        while _time.monotonic() < deadline:
+            a.heartbeat()
+            assert not [e for e in a.poll() if e.kind == "rank_lost"]
+            _time.sleep(0.05)
+        assert a.alive() == (0, 1)
+    finally:
+        b.stop_heartbeats()
+    # once the thread stops (process death), the lease expires as usual
+    t0 = _time.monotonic()
+    lost = []
+    while _time.monotonic() - t0 < 10.0 and not lost:
+        a.heartbeat()
+        lost = [e for e in a.poll() if e.kind == "rank_lost"]
+        _time.sleep(0.05)
+    assert [e.rank for e in lost] == [1]
+
+
+# ---------------------------------------------------------------------------
+# rendezvous + barrier
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_joins_and_times_out(tmp_path):
+    clock, (a, b) = make_world(tmp_path, 2)
+    b.heartbeat()
+    assert a.rendezvous(deadline_s=10.0) == (0, 1)
+    # a world that never fills names the missing ranks
+    solo = ms.Membership(str(tmp_path / "solo"), rank=0, world_size=3,
+                         lease_s=2.0, clock=clock, sleep=clock.sleep)
+    with pytest.raises(ms.DeadlineExceeded) as ei:
+        solo.rendezvous(deadline_s=3.0)
+    assert ei.value.missing == (1, 2)
+    json.dumps(ei.value.record())
+
+
+def test_rendezvous_backoff_is_capped_with_jitter(tmp_path):
+    clock = FakeClock()
+    # world of 2 that never fills: observe the sleep schedule
+    slept = []
+    m = ms.Membership(str(tmp_path), rank=0, world_size=2, lease_s=2.0,
+                      clock=clock, sleep=lambda s: (slept.append(s),
+                                                    clock.sleep(s))[-1])
+    with pytest.raises(ms.DeadlineExceeded):
+        m.rendezvous(deadline_s=20.0, backoff_s=0.1, backoff_factor=2.0,
+                     backoff_max_s=1.0)
+    # exponential up to the cap, plus jitter in [0, backoff_s)
+    bases = [min(0.1 * 2.0 ** k, 1.0) for k in range(len(slept))]
+    for got, base in zip(slept, bases):
+        assert base <= got < base + 0.1, (got, base)
+
+
+def test_rendezvous_retries_through_chaos_fault(tmp_path):
+    clock, (a, b) = make_world(tmp_path, 2)
+    b.heartbeat()
+    chaos.arm("comm.rendezvous=raise@0:count=2")
+    assert a.rendezvous(deadline_s=30.0) == (0, 1)
+    assert chaos.call_count("comm.rendezvous") >= 3
+
+
+def test_heartbeat_fires_chaos_point(tmp_path):
+    clock, (a,) = make_world(tmp_path, 1)
+    chaos.arm("comm.heartbeat=raise@1")  # seq counter starts at 1
+    with pytest.raises(chaos.ChaosFault):
+        a.heartbeat()
+
+
+def test_chaos_delay_on_heartbeat_reads_as_straggler(tmp_path):
+    # the injected straggler: a delay clause holds the heartbeat WRITE,
+    # so the peer observes exactly a late member — reported, not evicted
+    clock, (a, b) = make_world(tmp_path, 2, lease_s=2.0)
+    a.heartbeat(), b.heartbeat()
+    a.poll()
+
+    def delayed_heartbeat():
+        chaos.arm("comm.heartbeat=delay@0:count=99:sleep_s=0.01:seed=5")
+        try:
+            b.heartbeat()
+        finally:
+            chaos.disarm()
+
+    clock.sleep(1.5)  # b silent past straggler_after, inside lease
+    evs = a.poll()
+    assert [e.rank for e in evs if e.kind == "straggler"] == [1]
+    delayed_heartbeat()  # b eventually lands its (delayed) write
+    evs = a.poll()
+    assert a.alive() == (0, 1) and a.lost() == ()
+
+
+def test_barrier_completes_and_reports_stragglers(tmp_path):
+    clock, (a, b) = make_world(tmp_path, 2, lease_s=60.0)
+    a.heartbeat(), b.heartbeat()
+    a.poll(), b.poll()
+    a.arrive("e0")
+    res = b.barrier("e0", deadline_s=10.0)
+    assert res["arrived"] == [0, 1] and res["stragglers"] == []
+    res = a.barrier("e0", deadline_s=10.0)
+    assert res["arrived"] == [0, 1]
+
+
+def test_barrier_deadline_names_missing_rank(tmp_path):
+    clock, (a, b) = make_world(tmp_path, 2, lease_s=60.0)
+    a.heartbeat(), b.heartbeat()
+    a.poll()
+    with pytest.raises(ms.DeadlineExceeded) as ei:
+        a.barrier("e1", deadline_s=1.0)
+    assert ei.value.missing == (1,)
+    assert "e1" in str(ei.value)
+
+
+def test_barrier_fails_fast_on_rank_loss(tmp_path):
+    clock, (a, b) = make_world(tmp_path, 2, lease_s=2.0)
+    a.heartbeat(), b.heartbeat()
+    a.poll()
+    clock.sleep(2.5)  # b's lease will expire during the wait
+    with pytest.raises(ms.RankLostError) as ei:
+        a.barrier("e2", deadline_s=50.0)
+    assert ei.value.lost_ranks == (1,)
+    rec = ei.value.record()
+    assert rec["exit_code"] == ms.RANK_LOST_EXIT_CODE == 19
+    json.dumps(rec)
+
+
+# ---------------------------------------------------------------------------
+# CLI selftest (tier-1 registration)
+# ---------------------------------------------------------------------------
+
+
+def test_membership_selftest_cli():
+    r = subprocess.run(
+        [sys.executable, "-m", "dgraph_tpu.comm.membership",
+         "--selftest", "true"],
+        capture_output=True, text=True, timeout=180, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["kind"] == "membership_selftest" and rec["failures"] == []
+    assert rec["run_health"]["wedge"] == "none"

@@ -613,8 +613,12 @@ def test_supervise_standalone_twin_contract():
         twin = load(
             "_dgraph_train_supervise", "dgraph_tpu", "train", "supervise.py"
         )
+        from dgraph_tpu.comm.membership import RANK_LOST_EXIT_CODE
+
         assert twin.WEDGED_EXIT_CODE == WEDGED_EXIT_CODE == 17
         assert twin.ATTEMPT_ENV_VAR == chaos.ATTEMPT_ENV_VAR
+        assert twin.RANK_ENV_VAR == chaos.RANK_ENV_VAR
+        assert twin.RANK_LOST_EXIT_CODE == RANK_LOST_EXIT_CODE == 19
         assert pkg.WEDGED_EXIT_CODE == twin.WEDGED_EXIT_CODE
         # the twin's supervise() runs end to end without the package
         lineage = twin.supervise(

@@ -562,6 +562,62 @@ def test_restore_checkpoint_falls_back_over_corrupt_step(tmp_path, caplog):
     assert restore_checkpoint(str(tmp_path / "nothing"), template) is None
 
 
+def test_restore_checkpoint_quarantines_corrupt_step(tmp_path, caplog):
+    # a known-bad step is renamed to <step>.corrupt so it is read (and
+    # warned about) exactly ONCE — never silently re-read on every
+    # subsequent load — and leaves the resume candidate set
+    from dgraph_tpu.train.checkpoint import (
+        all_steps,
+        quarantined_steps,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    ckpt = str(tmp_path / "ckpt")
+    template = {"params": {"w": np.zeros(3, np.float32)}, "step": 0}
+    save_checkpoint(ckpt, {"params": {"w": np.ones(3, np.float32)},
+                           "step": 1}, 1)
+    save_checkpoint(ckpt, {"params": {"w": np.full(3, 2.0, np.float32)},
+                           "step": 2}, 2)
+    assert _truncate_tree(str(tmp_path / "ckpt" / "step_00000002")) > 0
+
+    with caplog.at_level("WARNING", logger="dgraph_tpu.checkpoint"):
+        got = restore_checkpoint(ckpt, template)
+    assert got["step"] == 1
+    assert sum("quarantined" in r.message for r in caplog.records) == 1
+    # the rename is what makes "log once" true
+    assert os.path.isdir(str(tmp_path / "ckpt" / "step_00000002.corrupt"))
+    assert all_steps(ckpt) == [1]
+    assert quarantined_steps(ckpt) == [2]
+
+    # the second load never touches the bad step again: no new warning
+    caplog.clear()
+    with caplog.at_level("WARNING", logger="dgraph_tpu.checkpoint"):
+        got = restore_checkpoint(ckpt, template)
+    assert got["step"] == 1
+    assert not caplog.records
+
+    # quarantine is reversible: rename back -> a resume candidate again
+    os.rename(str(tmp_path / "ckpt" / "step_00000002.corrupt"),
+              str(tmp_path / "ckpt" / "step_00000002"))
+    assert all_steps(ckpt) == [1, 2] and quarantined_steps(ckpt) == []
+
+    # an explicitly NAMED step never quarantines: the failure may be a
+    # template mismatch, and destroying evidence for a mislabeled read
+    # would be worse than the retry
+    with pytest.raises(Exception):
+        restore_checkpoint(ckpt, template, step=2)
+    assert all_steps(ckpt) == [1, 2]
+
+    # ALL steps failing is likely systematic (template mismatch, broken
+    # reader): nothing is quarantined — only a SUCCESSFUL older restore
+    # proves the failures were genuine corruption
+    _truncate_tree(str(tmp_path / "ckpt" / "step_00000001"))
+    with pytest.raises(Exception):
+        restore_checkpoint(ckpt, template)
+    assert all_steps(ckpt) == [1, 2] and quarantined_steps(ckpt) == []
+
+
 def test_cached_edge_plan_rebuilds_truncated_pickle(tmp_path, caplog):
     from dgraph_tpu.train.checkpoint import cached_edge_plan
 
