@@ -439,7 +439,8 @@ def bench_gcn(dtype_name: str):
     plan_np, _ = build_edge_plan(
         edge_index, part, world_size=1, edge_owner="dst",
         pad_multiple=pad_multiple,
-        overlap=True if tuned_halo_impl == "overlap" else None,
+        # both split lowerings ride the interior/boundary split
+        overlap=True if tuned_halo_impl in ("overlap", "pallas_p2p") else None,
     )
     # interior/boundary split of the workload (plan.py): the boundary
     # fraction bounds the halo payload, the interior fraction bounds what
@@ -511,10 +512,26 @@ def bench_gcn(dtype_name: str):
     #     (read E.H, write V.H each)
     per_layer = 6 * (Ep * H + Vp * H) * b
     hbm_bytes = 2 * per_layer + 3 * (Vp * (F + H) * b)  # + input/proj streams
+    # the RESOLVED lowering + deciding source (env pin > record >
+    # heuristic > plan), not just the record's wish: an env-pinned or
+    # heuristic-chosen lowering was previously invisible in BENCH_r*.json.
+    # On this single-chip plan the truthful resolution is usually
+    # ('none', 'plan'); halo_impl_env_pin records the operator's raw
+    # request alongside, so a pinned-but-degraded state is still visible.
+    from dgraph_tpu import config as _dcfg
+    from dgraph_tpu.plan import resolve_halo_impl
+
+    halo_impl, halo_impl_source = resolve_halo_impl(
+        plan_np.world_size, plan_np.halo_deltas,
+        overlap_available=plan_np.overlap is not None,
+    )
     split_info = {
         "interior_edge_frac": round(edge_split["interior_frac"], 4),
         "boundary_edge_frac": round(edge_split["boundary_frac"], 4),
         "tuned_halo_impl": tuned_halo_impl,
+        "halo_impl": halo_impl,
+        "halo_impl_source": halo_impl_source,
+        "halo_impl_env_pin": _dcfg.halo_impl,
     }
     if dt_ms != dt_ms:  # NaN timing: no roofline numbers (keep JSON valid;
         # the record id still rides along — a null metric must stay

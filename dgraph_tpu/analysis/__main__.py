@@ -184,6 +184,53 @@ _FIXTURES = {
 }
 
 
+# the pallas_p2p kernel module gets its own fixture pair per trace-
+# discipline rule: the one-sided transport is the newest place a config
+# read or span could sneak inside traced code, so the rules must
+# demonstrably fire (and stay quiet) on that path too
+_P2P_FIXTURES = {
+    "no-config-read-in-trace": {
+        "path": "dgraph_tpu/ops/pallas_p2p.py",
+        "bad": (
+            "from dgraph_tpu import config as _cfg\n"
+            "import jax\n"
+            "def p2p_transport(x):\n"
+            "    def body(y):\n"
+            "        return y if _cfg.use_pallas_p2p else -y\n"
+            "    return jax.jit(body)(x)\n"
+        ),
+        "good": (
+            "from dgraph_tpu import config as _cfg\n"
+            "import jax\n"
+            "def p2p_transport(x):\n"
+            "    interpret = _cfg.pallas_p2p_available()\n"
+            "    def body(y):\n"
+            "        return y if interpret else -y\n"
+            "    return jax.jit(body)(x)\n"
+        ),
+    },
+    "no-span-in-trace": {
+        "path": "dgraph_tpu/ops/pallas_p2p.py",
+        "bad": (
+            "import jax\n"
+            "from dgraph_tpu.obs import spans\n"
+            "def p2p_transport(x):\n"
+            "    def body(y):\n"
+            "        with spans.span('p2p.put', stage='exchange'):\n"
+            "            return y * 2\n"
+            "    return jax.jit(body)(x)\n"
+        ),
+        "good": (
+            "import jax\n"
+            "from dgraph_tpu.obs import spans\n"
+            "def p2p_transport(x):\n"
+            "    with spans.span('p2p.transport', stage='exchange'):\n"
+            "        return jax.jit(lambda y: y * 2)(x)\n"
+        ),
+    },
+}
+
+
 def _check(failures, cond, msg):
     if not cond:
         failures.append(msg)
@@ -192,7 +239,10 @@ def _check(failures, cond, msg):
 def _lint_fixture_checks(failures: list) -> None:
     from dgraph_tpu.analysis import lint as L
 
-    for name, fx in _FIXTURES.items():
+    fixture_sets = list(_FIXTURES.items()) + [
+        (name, fx) for name, fx in _P2P_FIXTURES.items()
+    ]
+    for name, fx in fixture_sets:
         rule = L.RULES[name]
         for kind, src in (("bad", fx["bad"]), ("good", fx["good"])):
             tree = ast.parse(src)
@@ -202,11 +252,15 @@ def _lint_fixture_checks(failures: list) -> None:
             else:
                 got = rule.check(fx["path"], tree, lines)
             if kind == "bad":
-                _check(failures, got, f"rule {name!r} missed its fixture")
+                _check(
+                    failures, got,
+                    f"rule {name!r} missed its fixture ({fx['path']})",
+                )
             else:
                 _check(
                     failures, not got,
-                    f"rule {name!r} false-positived on clean code: {got}",
+                    f"rule {name!r} false-positived on clean code "
+                    f"({fx['path']}): {got}",
                 )
     # pragma suppression: the bad jax-free fixture goes quiet when allowed
     src = "def poison(tree):\n    import jax  # lint: allow(jax-free-module)\n"
@@ -263,6 +317,47 @@ def _audit_vacuity_checks(failures: list, w2, w4) -> None:
         )
     finally:
         _cfg.set_flags(halo_impl=saved[0], tuned_halo_impl=saved[1])
+
+    # mixed pallas_p2p + ppermute legs in ONE program must stay RED in
+    # the one-family audit: the exchange lowered as one-sided puts but
+    # its reverse leg as ppermute rounds is exactly the PR 4 hazard in
+    # its newest costume
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from dgraph_tpu.comm import collectives
+    from dgraph_tpu.comm.mesh import GRAPH_AXIS, plan_in_specs, squeeze_plan
+
+    def mixed(xs, plan):
+        def body(plan_, x):
+            p = squeeze_plan(plan_)
+            buf = collectives.halo_exchange(
+                x[0], p.halo, GRAPH_AXIS, deltas=p.halo_deltas,
+                impl="pallas_p2p",
+            )
+            back = collectives.halo_scatter_sum(
+                buf, p.halo, p.n_src_pad, GRAPH_AXIS,
+                deltas=p.halo_deltas, impl="ppermute",
+            )
+            return back[None]
+
+        return jax.shard_map(
+            body, mesh=w2.mesh,
+            in_specs=(plan_in_specs(w2.plan), P(GRAPH_AXIS)),
+            out_specs=P(GRAPH_AXIS), check_vma=False,
+        )(plan, xs)
+
+    mism = []
+    T._audit_one_program(
+        "vacuity-mixed", "pallas_p2p", mixed,
+        (w2.batch["x"], w2.plan), w2.plan_np, mism,
+    )
+    _check(
+        failures,
+        any("mixed halo lowerings" in m for m in mism),
+        "auditor accepted a program mixing pallas_p2p puts with a "
+        "ppermute leg",
+    )
 
     # dropped donation: a step that returns only metrics must report the
     # params/opt_state donations unmatched

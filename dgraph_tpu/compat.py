@@ -85,6 +85,119 @@ def _set_mesh_04x(mesh):
     return mesh
 
 
+_dma_patch_installed = False
+
+
+def install_multiaxis_remote_dma() -> None:
+    """Teach Pallas interpret mode's remote-DMA discharge about multi-axis
+    meshes (idempotent; called lazily by :mod:`dgraph_tpu.ops.pallas_p2p`).
+
+    jax 0.4.x's ``dma_start`` discharge rule — what runs a
+    ``make_async_remote_copy`` put under ``pallas_call(interpret=True)`` —
+    raises NotImplementedError whenever the axis env holds more than one
+    named axis, and every dgraph mesh is ``('replica', 'graph')``. The
+    underlying machinery generalizes directly: a LOGICAL device id is the
+    raveled index over the mesh axes (row-major in axis-env order), so
+    the patched rule all-gathers over the TUPLE of named axes and matches
+    the sender by that raveled id. Single-axis envs defer verbatim to the
+    original rule — zero behavior change anywhere else.
+    (:func:`dgraph_tpu.ops.pallas_p2p.p2p_transport` computes its device
+    ids with the same raveling, so interpret mode and real Mosaic
+    lowerings agree.)"""
+    global _dma_patch_installed
+    if _dma_patch_installed:
+        return
+    if jax_version() >= (0, 6):
+        # the patch is built from 0.4.x internals; on newer jax defer to
+        # upstream entirely — if its interpret mode still cannot discharge
+        # a multi-axis remote DMA, its own NotImplementedError surfaces
+        # loudly, which beats silently replacing a working rule with
+        # 0.4.x-semantics code (the RELAXED_CHECKS gating precedent)
+        _dma_patch_installed = True
+        return
+    import jax.numpy as jnp
+    from jax import tree_util
+    from jax._src import core as jax_core
+    from jax._src.pallas import core as pl_core
+    from jax._src.pallas.mosaic import primitives as _prims
+    from jax._src.state import discharge as state_discharge
+
+    original = _prims.dma_start_discharge_rule
+
+    def patched(in_avals, out_avals, *args, tree, device_id_type):
+        axis_env = jax_core.get_axis_env()
+        nonempty = [n for n in axis_env.axis_sizes if n is not None]
+        if (
+            len(nonempty) <= 1
+            or device_id_type != _prims.DeviceIdType.LOGICAL
+        ):
+            return original(
+                in_avals, out_avals, *args, tree=tree,
+                device_id_type=device_id_type,
+            )
+        (src_ref, src_transforms, dst_ref, dst_transforms, dst_sem,
+         dst_sem_transforms, src_sem, src_sem_transforms, device_id,
+         ) = tree_util.tree_unflatten(tree, args)
+        (_, src_transforms_avals, _, dst_transforms_avals, dst_sem_aval,
+         dst_sem_transforms_avals, src_sem_aval, src_sem_transforms_avals,
+         _) = tree_util.tree_unflatten(tree, in_avals)
+        del out_avals
+        num_src_sem_t = len(tree_util.tree_leaves(src_sem_transforms_avals))
+        num_dst_sem_t = len(tree_util.tree_leaves(dst_sem_transforms_avals))
+        num_src_t = len(tree_util.tree_leaves(src_transforms_avals))
+        num_dst_t = len(tree_util.tree_leaves(dst_transforms_avals))
+
+        updates = state_discharge.transform_array(src_ref, src_transforms)
+        local_src = updates
+
+        # raveled logical id over ALL named axes, row-major in env order
+        axes = tuple(nonempty)
+        sizes = [axis_env.axis_sizes[a] for a in axes]
+        my_logical = 0
+        for a, s in zip(axes, sizes):
+            my_logical = my_logical * s + jax.lax.axis_index(a)
+        who_copy_to_me = jax.lax.all_gather(device_id, axes) == my_logical
+        index = jnp.argmax(who_copy_to_me, axis=0)
+        global_updates = jax.lax.all_gather(updates, axes)
+        updates = jax.lax.dynamic_index_in_dim(
+            global_updates, index, axis=0, keepdims=False)
+        global_dst_t = tree_util.tree_map(
+            lambda x: jax.lax.all_gather(x, axes), dst_transforms)
+        dst_transforms = tree_util.tree_map(
+            lambda x: jax.lax.dynamic_index_in_dim(
+                x, index, axis=0, keepdims=False),
+            global_dst_t,
+        )
+        _, new_dst = state_discharge.transform_swap_array(
+            dst_ref, dst_transforms, updates)
+
+        recv_size = jnp.minimum(updates.size, pl_core.SEMAPHORE_MAX_VALUE)
+        recv_size = jnp.array(
+            recv_size, dtype=pl_core.SEMAPHORE_INTERPRET_DTYPE)
+        dst_sem_value = _prims._transform_semaphore(
+            dst_sem, dst_sem_transforms, dst_sem_aval)
+        _, new_dst_sem = state_discharge.transform_swap_array(
+            dst_sem, dst_sem_transforms, dst_sem_value + recv_size)
+        send_size = jnp.minimum(local_src.size, pl_core.SEMAPHORE_MAX_VALUE)
+        send_size = jnp.array(
+            send_size, dtype=pl_core.SEMAPHORE_INTERPRET_DTYPE)
+        src_sem_value = _prims._transform_semaphore(
+            src_sem, src_sem_transforms, src_sem_aval)
+        _, new_src_sem = state_discharge.transform_swap_array(
+            src_sem, src_sem_transforms, src_sem_value + send_size)
+
+        new_vals = (None,) + (None,) * num_src_t
+        new_vals += (new_dst,) + (None,) * num_dst_t
+        new_vals += (new_dst_sem,) + (None,) * num_dst_sem_t
+        new_vals += (new_src_sem,) + (None,) * num_src_sem_t
+        new_vals += (None,)  # device_id
+        assert len(new_vals) == len(in_avals)
+        return new_vals, []
+
+    state_discharge.register_discharge_rule(_prims.dma_start_p)(patched)
+    _dma_patch_installed = True
+
+
 def install() -> None:
     """Idempotently fill missing jax attributes (called on package import)."""
     if not hasattr(jax, "shard_map"):

@@ -72,6 +72,28 @@ def pallas_fused_bwd_enabled() -> bool:
         return use_pallas_fused_bwd
     return True
 
+# The device-initiated one-sided halo transport (halo_impl="pallas_p2p":
+# pltpu.make_async_remote_copy puts issued from inside the Pallas kernel,
+# ops.pallas_p2p). Tri-state like the scatter kernels: None = auto (the
+# lowering is AVAILABLE on a TPU backend — actual adoption still requires
+# an env pin or tuned record; resolve_halo_impl never heuristically picks
+# an un-A/B'd kernel), True forces availability on ANY backend (off-TPU
+# the kernels run in Pallas interpret mode — how the tier-1 parity pins
+# run without a chip), False vetoes it everywhere.
+use_pallas_p2p: bool | None = _env_flag("DGRAPH_TPU_PALLAS_P2P", None)
+
+
+def pallas_p2p_available() -> bool:
+    """Can halo_impl='pallas_p2p' lower on this backend? (One of the two
+    gates resolve_halo_impl applies; the other is the plan carrying the
+    interior/boundary split.)"""
+    if use_pallas_p2p is not None:
+        return use_pallas_p2p
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
 # Mosaic flash-attention kernel for the Ulysses full-sequence per-head
 # attention (parallel/sequence.py). Tri-state like the scatter kernels:
 # None = auto (ON on TPU when shapes qualify), env DGRAPH_TPU_FLASH_ATTN
@@ -123,7 +145,9 @@ gather_col_block: int = int(os.environ.get("DGRAPH_TPU_GATHER_COL_BLOCK", "128")
 # active peer-delta set is sparse, else one padded all_to_all; 'overlap'
 # — interior/boundary split with the boundary rounds hidden behind
 # interior aggregation — whenever the plan carries its OverlapSpec),
-# 'all_to_all', 'ppermute', or 'overlap'. Resolution precedence lives in
+# 'all_to_all', 'ppermute', 'overlap', or 'pallas_p2p' (device-initiated
+# one-sided puts fused into the Pallas kernel; needs the overlap split
+# AND pallas_p2p_available()). Resolution precedence lives in
 # plan.resolve_halo_impl: this env pin > the adopted tuning record
 # (tuned_halo_impl below) > the cost-model heuristic.
 halo_impl: str = os.environ.get("DGRAPH_TPU_HALO_IMPL", "auto")

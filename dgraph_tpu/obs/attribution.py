@@ -55,7 +55,7 @@ import os
 import re
 from typing import Optional
 
-DEFAULT_IMPLS = ("all_to_all", "overlap")
+DEFAULT_IMPLS = ("all_to_all", "overlap", "pallas_p2p")
 SCHEMA_VERSION = 1
 
 
@@ -203,9 +203,15 @@ def _train_scan(w, *, with_optimizer: bool, elide_exchange: bool = False):
         grads = _compat.sync_inbody_grads(grads, (GRAPH_AXIS,))
         return grads, lax.psum(loss, GRAPH_AXIS)
 
+    from dgraph_tpu.comm.collectives import shard_map_checks
+    from dgraph_tpu.comm.mesh import GRAPH_AXIS as _GA
+
     grad_fn = jax.shard_map(
         shard_body, mesh=mesh,
         in_specs=(P(), batch_specs, plan_specs), out_specs=(P(), P()),
+        # pallas_p2p programs relax the 0.4.x rep checker (pallas_call
+        # has no replication rule there); every other lowering keeps it
+        **shard_map_checks(plan, _GA),
     )
 
     @functools.partial(jax.jit, static_argnames="n", donate_argnums=(0, 1))
@@ -279,9 +285,12 @@ def _exchange_scan(w, impl: str, num_layers: int = 2):
             h = h + back * 1e-6
         return h[None]
 
+    from dgraph_tpu.comm.collectives import shard_map_checks
+
     sm = jax.shard_map(
         shard_body, mesh=mesh,
         in_specs=(P(GRAPH_AXIS), plan_specs), out_specs=P(GRAPH_AXIS),
+        **shard_map_checks(plan, GRAPH_AXIS),
     )
 
     @functools.partial(jax.jit, static_argnames="n", donate_argnums=(0,))
@@ -354,7 +363,7 @@ def scan_delta_attribution(
                 return ms
         return float("nan")
 
-    saved = (_cfg.halo_impl, _cfg.tuned_halo_impl)
+    saved = (_cfg.halo_impl, _cfg.tuned_halo_impl, _cfg.use_pallas_p2p)
     by_impl = {}
     try:
         # interior-only (exchange elided) is lowering-independent: one
@@ -366,6 +375,12 @@ def scan_delta_attribution(
 
         for impl in impls:
             _cfg.set_flags(halo_impl=impl, tuned_halo_impl=None)
+            # pinning pallas_p2p on the (wedged-round) CPU backend needs
+            # the explicit availability opt-in: the kernels execute in
+            # Pallas interpret mode, timed like any other lowering
+            _cfg.set_flags(
+                use_pallas_p2p=True if impl == "pallas_p2p" else saved[2]
+            )
             run, state = _train_scan(w, with_optimizer=True)
             t_full = time_one(run, state)
             run, state = _train_scan(w, with_optimizer=False)
@@ -400,7 +415,10 @@ def scan_delta_attribution(
                 "exposed_exchange_ms": _num(exposed),
             }
     finally:
-        _cfg.set_flags(halo_impl=saved[0], tuned_halo_impl=saved[1])
+        _cfg.set_flags(
+            halo_impl=saved[0], tuned_halo_impl=saved[1],
+            use_pallas_p2p=saved[2],
+        )
 
     rec = {
         "kind": "cpu_scan_delta",
@@ -441,7 +459,7 @@ class Config:
     num_classes: int = 4
     n_long: int = 6
     reps: int = 1
-    impls: str = "all_to_all,overlap"
+    impls: str = "all_to_all,overlap,pallas_p2p"
     seed: int = 0
     log_path: str = "logs/attribution.jsonl"
     indent: int = 0

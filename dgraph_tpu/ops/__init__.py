@@ -1,3 +1,7 @@
 from dgraph_tpu.ops import local
 
+# dgraph_tpu.ops.pallas_segment and dgraph_tpu.ops.pallas_p2p are
+# imported lazily by their dispatch points (ops.local, comm.collectives)
+# so importing the package never pays the Pallas import on paths that
+# don't run kernels.
 __all__ = ["local"]

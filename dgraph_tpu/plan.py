@@ -543,12 +543,14 @@ def pick_halo_impl(world_size: int, halo_deltas: tuple) -> str:
 
 
 def resolve_halo_impl(
-    world_size: int, halo_deltas: tuple, *, overlap_available: bool = False
+    world_size: int, halo_deltas: tuple, *, overlap_available: bool = False,
+    p2p_available: "bool | None" = None,
 ) -> tuple[str, str]:
     """The halo lowering the run will actually execute, plus who decided.
 
     Returns ``(impl, source)`` with impl one of ``'none'``,
-    ``'all_to_all'``, ``'ppermute'``, ``'overlap'`` and source one of:
+    ``'all_to_all'``, ``'ppermute'``, ``'overlap'``, ``'pallas_p2p'`` and
+    source one of:
 
     - ``'env'``       — ``DGRAPH_TPU_HALO_IMPL`` (or ``config.set_flags``)
       pins the lowering; the operator's word is final.
@@ -568,6 +570,20 @@ def resolve_halo_impl(
     (an env-pin miss still honors an adopted record, then the heuristic),
     never a silent wrong answer.
 
+    ``'pallas_p2p'`` (device-initiated one-sided puts,
+    :mod:`dgraph_tpu.ops.pallas_p2p`) is gated TWICE: the plan must carry
+    the overlap split (its model routing rides the interior/boundary
+    streams) and the backend must be able to lower the kernels
+    (``config.pallas_p2p_available()``: a TPU backend, or the explicit
+    ``DGRAPH_TPU_PALLAS_P2P=1`` opt-in that runs them in Pallas interpret
+    mode). A pin that misses either gate degrades with a one-time warning
+    exactly like an overlap pin without the split. ``p2p_available``
+    overrides the config/backend probe (the probe imports jax, so it is
+    only consulted when a pallas_p2p pin or record is actually present).
+    The heuristic tier never picks ``pallas_p2p`` on its own — an
+    un-A/B'd kernel engages only through an explicit pin or a persisted
+    tuning record (the ``use_pallas_gather`` precedent).
+
     Every consumer of the decision (``comm.collectives``'s runtime dispatch,
     ``obs.footprint``'s byte accounting, :func:`plan_efficiency`'s report)
     resolves through here, so what runs, what is accounted, and what is
@@ -577,6 +593,14 @@ def resolve_halo_impl(
 
     if not halo_deltas:
         return "none", "plan"
+
+    def _p2p_ok() -> bool:
+        if not overlap_available:
+            return False
+        if p2p_available is not None:
+            return p2p_available
+        return _cfg.pallas_p2p_available()
+
     legal = ("all_to_all", "ppermute") + (("overlap",) if overlap_available else ())
     for impl, source in (
         (_cfg.halo_impl, "env"),
@@ -586,6 +610,10 @@ def resolve_halo_impl(
             return impl, source
         if impl == "overlap":  # pinned but the plan carries no split
             _warn_overlap_unavailable(source)
+        if impl == "pallas_p2p":
+            if _p2p_ok():
+                return impl, source
+            _warn_p2p_unavailable(source, overlap_available)
     if overlap_available:
         return "overlap", "heuristic"
     return pick_halo_impl(world_size, halo_deltas), "heuristic"
@@ -594,14 +622,17 @@ def resolve_halo_impl(
 def resolve_overlap_intent() -> bool:
     """Whether a plan built RIGHT NOW with ``overlap=None`` (auto) would
     attach the interior/boundary split: the env pin or the adopted tuning
-    record asks for the overlap lowering. The ONE copy of this rule —
+    record asks for the overlap lowering — or for ``pallas_p2p``, which
+    rides the same split (its model routing aggregates interior edges
+    while the one-sided puts are in flight). The ONE copy of this rule —
     ``build_edge_plan``'s auto default and the plan cache's fingerprint
     (``train.checkpoint.cached_edge_plan``) both resolve through here, so
     what gets built and what the cache key claims was built can never
     diverge."""
     from dgraph_tpu import config as _cfg
 
-    return "overlap" in (_cfg.halo_impl, _cfg.tuned_halo_impl)
+    intents = (_cfg.halo_impl, _cfg.tuned_halo_impl)
+    return "overlap" in intents or "pallas_p2p" in intents
 
 
 _overlap_warned: set = set()
@@ -615,6 +646,31 @@ def _warn_overlap_unavailable(source: str) -> None:
             "interior/boundary split (built without overlap=True); the "
             "next resolution tier decides the lowering instead", source,
         )
+
+
+_p2p_warned: set = set()
+
+
+def _warn_p2p_unavailable(source: str, overlap_available: bool) -> None:
+    key = (source, overlap_available)
+    if key in _p2p_warned:
+        return
+    _p2p_warned.add(key)
+    if not overlap_available:
+        why = (
+            "the plan carries no interior/boundary split (built without "
+            "overlap=True)"
+        )
+    else:
+        why = (
+            "the backend cannot lower the Pallas TPU kernels (set "
+            "DGRAPH_TPU_PALLAS_P2P=1 to force interpret-mode kernels "
+            "off-TPU)"
+        )
+    _logger.warning(
+        "halo_impl='pallas_p2p' requested by %s but %s; the next "
+        "resolution tier decides the lowering instead", source, why,
+    )
 
 
 def plan_efficiency(plan: EdgePlan, layout: EdgePlanLayout) -> dict:
@@ -827,6 +883,31 @@ def _reject_incompatible_knobs(
             "on owner-sorted edge order (monotone segment ids per subset); "
             "drop one of the two knobs"
         )
+    from dgraph_tpu import config as _cfg
+
+    if "pallas_p2p" in (_cfg.halo_impl, _cfg.tuned_halo_impl):
+        # fail the un-lowerable combos at build time, naming the knobs —
+        # not at the first pallas_call deep inside a jitted step
+        if not sort_edges:
+            raise ValueError(
+                "halo_impl='pallas_p2p' conflicts with sort_edges=False: "
+                "the one-sided lowering routes through the interior/"
+                "boundary split, which relies on owner-sorted edge order; "
+                "drop the pin or re-enable sort_edges"
+            )
+        if s_pad is not None and s_pad % 8:
+            raise ValueError(
+                f"halo_impl='pallas_p2p' conflicts with s_pad={s_pad}: the "
+                f"per-delta [s_pad, F] DMA tiles need 8-row (sublane) "
+                f"alignment; pick s_pad={_pad_to(s_pad, 8)} or drop the pin"
+            )
+        if pad_multiple % 8 and s_pad is None:
+            raise ValueError(
+                f"halo_impl='pallas_p2p' conflicts with pad_multiple="
+                f"{pad_multiple}: s_pad inherits this multiple and the "
+                f"per-delta DMA tiles need 8-row (sublane) alignment; use "
+                f"a multiple of 8 or pass an aligned explicit s_pad"
+            )
     if pad_multiple < 1:
         raise ValueError(f"pad_multiple={pad_multiple} must be >= 1")
     if e_pad is not None:

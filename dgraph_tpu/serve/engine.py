@@ -165,11 +165,16 @@ class ServeEngine:
             return model_apply(model, params, b, p, batch_args)[None]
 
         def full(params, batch, plan):
+            from dgraph_tpu.comm.collectives import shard_map_checks
+
             return jax.shard_map(
                 shard_body,
                 mesh=mesh,
                 in_specs=(P(), batch_specs, plan_specs),
                 out_specs=P(GRAPH_AXIS),
+                # pallas_p2p forwards relax the 0.4.x rep checker
+                # (pallas_call has no replication rule there)
+                **shard_map_checks(plan, GRAPH_AXIS),
             )(params, batch, plan)
 
         return full

@@ -46,12 +46,15 @@ def spmd_apply(mesh, fn, plan: EdgePlan, *arrays, static_args=()):
         out = fn(*[x[0] for x in xs], squeeze_plan(plan_), *static_args)
         return jax.tree.map(lambda o: o[None], out)
 
+    from dgraph_tpu.comm.collectives import shard_map_checks
+
     specs = tuple(P(GRAPH_AXIS) for _ in arrays)
     shmapped = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(plan_in_specs(plan),) + specs,
         out_specs=P(GRAPH_AXIS),
+        **shard_map_checks(plan, GRAPH_AXIS),
     )
     from jax._src.core import trace_state_clean
 

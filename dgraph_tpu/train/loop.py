@@ -221,12 +221,17 @@ def make_train_step(
         return grads, out
 
     def step(params, opt_state, batch, plan):
+        from dgraph_tpu.comm.collectives import shard_map_checks
+
         batch_specs = jax.tree.map(lambda _: batch_spec, batch)
         grads, metrics = jax.shard_map(
             shard_body,
             mesh=mesh,
             in_specs=(P(), batch_specs, plan_in_specs(plan)),
             out_specs=(P(), P()),
+            # pallas_p2p programs relax the 0.4.x rep checker (pallas_call
+            # has no replication rule there); all other lowerings keep it
+            **shard_map_checks(plan, GRAPH_AXIS),
         )(params, batch, plan)
         if nonfinite_guard:
             # one scalar decides the whole step: a single non-finite value
@@ -284,12 +289,15 @@ def make_eval_step(model, mesh, loss_fn: Callable = masked_cross_entropy,
         return {"loss": lax.psum(loss, GRAPH_AXIS), "accuracy": acc}
 
     def step(params, batch, plan):
+        from dgraph_tpu.comm.collectives import shard_map_checks
+
         batch_specs = jax.tree.map(lambda _: P(GRAPH_AXIS), batch)
         return jax.shard_map(
             shard_body,
             mesh=mesh,
             in_specs=(P(), batch_specs, plan_in_specs(plan)),
             out_specs=P(),
+            **shard_map_checks(plan, GRAPH_AXIS),
         )(params, batch, plan)
 
     return jax.jit(step)

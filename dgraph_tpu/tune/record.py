@@ -119,7 +119,7 @@ class TuningRecord:
                 errors.append(f"pad_multiple {pm!r} not a positive int")
             impl = self.config.get("halo_impl")
             if impl is not None and impl not in (
-                "none", "ppermute", "all_to_all", "overlap"
+                "none", "ppermute", "all_to_all", "overlap", "pallas_p2p"
             ):
                 errors.append(f"halo_impl {impl!r} unknown")
             serve = self.config.get("serve")
@@ -250,7 +250,7 @@ def adopt_record(rec: TuningRecord) -> dict:
     impl = rec.config.get("halo_impl")
     _cfg.set_flags(
         tuned_halo_impl=impl
-        if impl in ("ppermute", "all_to_all", "overlap")
+        if impl in ("ppermute", "all_to_all", "overlap", "pallas_p2p")
         else None
     )
     _cfg.set_flags(tuning_record_id=rec.record_id)

@@ -106,9 +106,28 @@ def candidate_cost(
     int_rows_max = max(split["interior_per_shard"] or [0])
     interior_leg_us = 3 * int_rows_max * row / (hbm_gbps * 1e3)
     overlap_exposed = 0.0
+    p2p_exposed = 0.0
     if n_d:
         pp_us = exch_bound("ppermute")
         overlap_exposed = max(pp_us - interior_leg_us, 0.0)
+        # pallas_p2p: the same boundary-only tiles as one-sided puts
+        # issued from inside the Pallas kernel — ONE launch instead of
+        # n_d collective rounds; the split routing hides the puts behind
+        # the interior aggregation like overlap does. HBM streams are
+        # billed at ppermute's (2*n_d + W) blocks: only the forward
+        # leg's in-VMEM mask fusion can skip a stream, and only when the
+        # stack fits the budget — the ranking must not credit a saving
+        # the reverse leg never delivers.
+        p2p_wire_us = wire.get("pallas_p2p", 0) / (ici_gbps * 1e3) + LAUNCH_US
+        p2p_hbm_us = (2 * n_d + W) * S * row / (hbm_gbps * 1e3)
+        p2p_exposed = max(max(p2p_wire_us, p2p_hbm_us) - interior_leg_us, 0.0)
+
+    # the pallas_p2p knob only enters the ranking where it can actually
+    # lower (TPU backend, or the explicit interpret opt-in) — a record
+    # should not persist a winner the run would degrade away from
+    from dgraph_tpu import config as _cfg
+
+    p2p_rankable = bool(n_d) and _cfg.pallas_p2p_available()
 
     if n_d == 0:
         impl, exch_us = "none", 0.0
@@ -118,12 +137,19 @@ def candidate_cost(
             "ppermute": exch_bound("ppermute"),
             "overlap": overlap_exposed,
         }
+        if p2p_rankable:
+            bounds["pallas_p2p"] = p2p_exposed
         # stable tie-break preserving the pre-overlap semantics: ppermute
-        # beats all_to_all on equal cost (as before), and overlap — equal
-        # to ppermute exactly when there is no interior work to hide
-        # behind — only wins when it actually hides something
-        order = ("ppermute", "all_to_all", "overlap")
-        impl = min(order, key=lambda k: (bounds[k], order.index(k)))
+        # beats all_to_all on equal cost (as before), overlap — equal to
+        # ppermute exactly when there is no interior work to hide behind
+        # — only wins when it actually hides something, and pallas_p2p
+        # (last) only when its one-launch fused transport strictly beats
+        # the overlap schedule
+        order = ("ppermute", "all_to_all", "overlap", "pallas_p2p")
+        impl = min(
+            (k for k in order if k in bounds),
+            key=lambda k: (bounds[k], order.index(k)),
+        )
         exch_us = bounds[impl]
 
     local_us = 6 * (plan.e_pad + plan.n_dst_pad) * row / (hbm_gbps * 1e3)
@@ -138,6 +164,10 @@ def candidate_cost(
         # overlap-knob pricing: both alternatives land in the trace so the
         # record's choice is auditable (overlap in {off, on} first-class)
         "overlap_exposed_us": round(overlap_exposed, 3),
+        # pallas_p2p-knob pricing: always priced (auditable even where it
+        # cannot lower); ranked only when pallas_p2p_rankable
+        "pallas_p2p_exposed_us": round(p2p_exposed, 3),
+        "pallas_p2p_rankable": p2p_rankable,
         "interior_frac": split["interior_frac"],
         "boundary_frac": split["boundary_frac"],
         "wire_efficiency": fp["collectives"]["halo_exchange"]["wire_efficiency"],

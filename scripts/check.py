@@ -39,6 +39,12 @@ PASSES = [
     ("membership-selftest",
      [sys.executable, "-m", "dgraph_tpu.comm.membership",
       "--selftest", "true"]),
+    # device-initiated one-sided halo transport: interpret-mode put
+    # parity vs the masked all_to_all on 2- and 4-shard rings (tiny CPU
+    # compiles only — the kernels never dial an accelerator here)
+    ("pallas-p2p-selftest",
+     [sys.executable, "-m", "dgraph_tpu.ops.pallas_p2p",
+      "--selftest", "true"]),
 ]
 
 EXTRA_SELFTESTS = [

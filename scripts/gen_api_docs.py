@@ -51,6 +51,9 @@ SECTIONS = [
     ("Pallas kernels", "dgraph_tpu.ops.pallas_segment",
      ["sorted_segment_sum", "sorted_segment_sum_bias_relu",
       "sorted_row_gather", "max_chunks_hint", "max_vblocks_hint"]),
+    ("Pallas one-sided halo transport", "dgraph_tpu.ops.pallas_p2p",
+     ["p2p_transport", "p2p_interpret_mode", "transport_fused_mask",
+      "FUSED_MASK_VMEM_BUDGET", "P2P_COLLECTIVE_ID"]),
     ("Models", "dgraph_tpu.models", None),
     ("GraphCast", "dgraph_tpu.models.graphcast", None),
     ("Tensor parallelism", "dgraph_tpu.parallel.tensor", None),

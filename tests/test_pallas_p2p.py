@@ -1,0 +1,332 @@
+"""Device-initiated one-sided halo exchange (halo_impl="pallas_p2p").
+
+Parity strategy (the bar the overlap lowering set, test_overlap.py): the
+one-sided put transport must be BIT-IDENTICAL to the padded all_to_all
+path, forward and backward, on the 2- and 4-shard synthetic graphs. The
+kernel is pure data movement (plus an exact elementwise mask multiply),
+so the pins run on the CPU backend via Pallas interpret mode — no chip
+needed. Resolution-ladder rows, knob rejection, and footprint pricing are
+host-only (no compiles).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu import config as cfg
+from dgraph_tpu import plan as pl
+from dgraph_tpu.comm import collectives
+from dgraph_tpu.comm.mesh import make_graph_mesh
+from dgraph_tpu.plan import shard_edge_data, shard_vertex_data
+from dgraph_tpu.testing import spmd_apply
+
+
+@pytest.fixture
+def impl_flags():
+    saved = (cfg.halo_impl, cfg.tuned_halo_impl, cfg.use_pallas_p2p)
+    yield
+    cfg.set_flags(
+        halo_impl=saved[0], tuned_halo_impl=saved[1], use_pallas_p2p=saved[2]
+    )
+
+
+def _case(rng, W, V=48, E=300):
+    part = np.sort(rng.integers(0, W, V)).astype(np.int32)
+    edges = np.stack([rng.integers(0, V, E), rng.integers(0, V, E)])
+    plan, layout = pl.build_edge_plan(edges, part, world_size=W, overlap=True)
+    return edges, part, plan, layout
+
+
+def _run_all(mesh, plan, xs, ed, ct_e, ct_v):
+    """One jitted program per lowering: gather fwd+grad and halo-side
+    scatter fwd+grad together (keeps the new-compile count low — the
+    tier-1 budget rule)."""
+
+    def everything(xs_, ed_):
+        out_g = spmd_apply(
+            mesh, collectives.gather, plan, xs_, static_args=("src", "graph")
+        )
+        g_g = jax.grad(
+            lambda x: jnp.sum(
+                spmd_apply(mesh, collectives.gather, plan, x,
+                           static_args=("src", "graph")) * ct_e
+            )
+        )(xs_)
+        out_s = spmd_apply(
+            mesh, collectives.scatter_sum, plan, ed_,
+            static_args=("src", "graph"),
+        )
+        g_s = jax.grad(
+            lambda e: jnp.sum(
+                spmd_apply(mesh, collectives.scatter_sum, plan, e,
+                           static_args=("src", "graph")) * ct_v
+            )
+        )(ed_)
+        return out_g, g_g, out_s, g_s
+
+    with jax.set_mesh(mesh):
+        return [np.asarray(a) for a in jax.jit(everything)(xs, ed)]
+
+
+@pytest.mark.parametrize("W", [2, 4])
+def test_p2p_bitwise_parity_with_all_to_all(rng, impl_flags, W):
+    """halo_exchange_p2p / halo_scatter_sum_p2p (through the gather and
+    halo-side scatter they lower) are bit-identical to the all_to_all
+    path, forward AND backward — the one-sided puts move the exact same
+    tiles; every arithmetic op is shared with the serial path."""
+    edges, part, plan, layout = _case(rng, W)
+    V, F = len(part), 5
+    xs = jnp.asarray(shard_vertex_data(
+        rng.normal(size=(V, F)).astype(np.float32),
+        layout.src_counts, plan.n_src_pad,
+    ))
+    ed = jnp.asarray(shard_edge_data(
+        rng.normal(size=(edges.shape[1], F)).astype(np.float32),
+        layout, plan.e_pad,
+    ))
+    ct_e = jnp.asarray(shard_edge_data(
+        rng.normal(size=(edges.shape[1], F)).astype(np.float32),
+        layout, plan.e_pad,
+    ))
+    ct_v = jnp.asarray(shard_vertex_data(
+        rng.normal(size=(V, F)).astype(np.float32),
+        layout.src_counts, plan.n_src_pad,
+    ))
+    mesh = make_graph_mesh(ranks_per_graph=W, devices=jax.devices()[:W])
+
+    cfg.set_flags(halo_impl="pallas_p2p", use_pallas_p2p=True)
+    got_p2p = _run_all(mesh, plan, xs, ed, ct_e, ct_v)
+    cfg.set_flags(halo_impl="all_to_all", use_pallas_p2p=None)
+    got_a2a = _run_all(mesh, plan, xs, ed, ct_e, ct_v)
+    for name, a, b in zip(
+        ("gather fwd", "gather grad", "scatter fwd", "scatter grad"),
+        got_p2p, got_a2a,
+    ):
+        np.testing.assert_array_equal(a, b, err_msg=f"{name} not bit-identical")
+
+
+def test_p2p_models_match_all_to_all(rng, impl_flags):
+    """Model-level routing (GCN fused + SAGE through split_active /
+    halo_exchange_split) agrees with the serial lowering — allclose, not
+    bitwise: the interior/boundary split regroups the owner-side float
+    accumulation (the overlap precedent)."""
+    from jax.sharding import PartitionSpec as P
+
+    from dgraph_tpu.comm import Communicator
+    from dgraph_tpu.comm.mesh import GRAPH_AXIS, plan_in_specs, squeeze_plan
+    from dgraph_tpu.models.gcn import GraphConvLayer
+    from dgraph_tpu.models.sage import SAGEConv
+
+    W, V, E, F = 2, 48, 300, 8
+    edges, part, plan, layout = _case(rng, W, V, E)
+    mesh = make_graph_mesh(ranks_per_graph=W, devices=jax.devices()[:W])
+    comm = Communicator.init_process_group("tpu", world_size=W)
+    xs = jnp.asarray(shard_vertex_data(
+        rng.normal(size=(V, F)).astype(np.float32),
+        layout.src_counts, plan.n_src_pad,
+    ))
+    modules = [
+        GraphConvLayer(out_features=8, comm=comm),  # fused bias+relu path
+        SAGEConv(out_features=8, comm=comm),  # identity-message path
+    ]
+
+    def run(module, impl):
+        cfg.set_flags(
+            halo_impl=impl,
+            use_pallas_p2p=True if impl == "pallas_p2p" else None,
+        )
+
+        def body(x_, p_):
+            psq = squeeze_plan(p_)
+            params = module.init(jax.random.key(0), x_[0], psq)
+            return module.apply(params, x_[0], psq)[None]
+
+        f = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(GRAPH_AXIS), plan_in_specs(plan)),
+            out_specs=P(GRAPH_AXIS),
+            **collectives.shard_map_checks(plan, GRAPH_AXIS),
+        )
+        with jax.set_mesh(mesh):
+            return np.asarray(jax.jit(f)(xs, jax.tree.map(jnp.asarray, plan)))
+
+    for module in modules:
+        a = run(module, "pallas_p2p")
+        b = run(module, "all_to_all")
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Host-only: resolution ladder, knob rejection, footprint pricing
+# ---------------------------------------------------------------------------
+
+
+class TestResolveP2PLadder:
+    """Decision-ladder rows for the new choice (mirror of test_plan's
+    TestResolveHaloImplLadder, host-only)."""
+
+    def test_env_pin_resolves(self, impl_flags):
+        cfg.set_flags(halo_impl="pallas_p2p", use_pallas_p2p=True)
+        impl, source = pl.resolve_halo_impl(2, (1,), overlap_available=True)
+        assert (impl, source) == ("pallas_p2p", "env")
+
+    def test_record_tier_resolves(self, impl_flags):
+        cfg.set_flags(
+            halo_impl="auto", tuned_halo_impl="pallas_p2p",
+            use_pallas_p2p=True,
+        )
+        impl, source = pl.resolve_halo_impl(2, (1,), overlap_available=True)
+        assert (impl, source) == ("pallas_p2p", "record")
+
+    def test_env_pin_beats_p2p_record(self, impl_flags):
+        cfg.set_flags(
+            halo_impl="all_to_all", tuned_halo_impl="pallas_p2p",
+            use_pallas_p2p=True,
+        )
+        impl, source = pl.resolve_halo_impl(2, (1,), overlap_available=True)
+        assert (impl, source) == ("all_to_all", "env")
+
+    def test_degrades_without_split(self, impl_flags):
+        """A pallas_p2p pin on a plan with no interior/boundary split must
+        fall to a lowerable tier, never half-lower."""
+        cfg.set_flags(halo_impl="pallas_p2p", use_pallas_p2p=True)
+        impl, source = pl.resolve_halo_impl(2, (1,), overlap_available=False)
+        assert impl in ("ppermute", "all_to_all")
+        assert source == "heuristic"
+
+    def test_degrades_without_backend(self, impl_flags):
+        """...and on a backend that cannot lower the kernels (no TPU, no
+        interpret opt-in) — the record tier still gets its chance."""
+        cfg.set_flags(
+            halo_impl="pallas_p2p", tuned_halo_impl="overlap",
+            use_pallas_p2p=False,
+        )
+        impl, source = pl.resolve_halo_impl(2, (1,), overlap_available=True)
+        assert (impl, source) == ("overlap", "record")
+
+    def test_heuristic_never_picks_p2p(self, impl_flags):
+        """The heuristic tier must not auto-adopt an un-A/B'd kernel even
+        where it is available (pin/record only — the use_pallas_gather
+        precedent)."""
+        cfg.set_flags(
+            halo_impl="auto", tuned_halo_impl=None, use_pallas_p2p=True
+        )
+        impl, source = pl.resolve_halo_impl(2, (1,), overlap_available=True)
+        assert impl == "overlap"
+        assert source == "heuristic"
+
+    def test_p2p_intent_builds_split(self, rng, impl_flags):
+        """An env pin makes build_edge_plan(overlap=None) attach the
+        interior/boundary split — same auto rule as overlap."""
+        cfg.set_flags(halo_impl="pallas_p2p", use_pallas_p2p=True)
+        W, V = 2, 32
+        part = np.sort(rng.integers(0, W, V)).astype(np.int32)
+        edges = np.stack([np.arange(V), (np.arange(V) + 1) % V])
+        plan, _ = pl.build_edge_plan(edges, part, world_size=W)  # auto
+        assert plan.overlap is not None
+
+
+class TestRejectIncompatibleP2PKnobs:
+    def test_rejects_unsorted_edges(self, rng, impl_flags):
+        cfg.set_flags(halo_impl="pallas_p2p", use_pallas_p2p=True)
+        W, V = 2, 32
+        part = np.sort(rng.integers(0, W, V)).astype(np.int32)
+        edges = np.stack([rng.integers(0, V, 64), rng.integers(0, V, 64)])
+        with pytest.raises(ValueError, match="pallas_p2p.*sort_edges"):
+            pl.build_edge_plan(
+                edges, part, world_size=W, sort_edges=False, overlap=False
+            )
+
+    def test_rejects_unaligned_s_pad(self, rng, impl_flags):
+        cfg.set_flags(halo_impl="pallas_p2p", use_pallas_p2p=True)
+        W, V = 2, 32
+        part = np.sort(rng.integers(0, W, V)).astype(np.int32)
+        edges = np.stack([np.arange(V), (np.arange(V) + 1) % V])
+        with pytest.raises(ValueError, match="pallas_p2p.*s_pad"):
+            pl.build_edge_plan(
+                edges, part, world_size=W, s_pad=12, pad_multiple=1
+            )
+
+
+def test_footprint_p2p_pricing(rng, impl_flags):
+    """The pinned pallas_p2p lowering is priced: boundary-only operand
+    (same wire bytes as the rounds it replaces), a CONSERVATIVE headline
+    HBM figure (the reverse leg always pre-stages its tiles — only the
+    fused forward leg can skip a stream, reported separately), and a
+    per-tile schedule with exposed <= serial."""
+    _, _, plan, _ = _case(rng, 4)
+    from dgraph_tpu.obs.footprint import plan_footprint
+
+    cfg.set_flags(halo_impl="pallas_p2p", use_pallas_p2p=True)
+    fp = plan_footprint(plan, "bfloat16", 32)
+    ex = fp["collectives"]["halo_exchange"]
+    assert ex["impl"] == "pallas_p2p"
+    assert ex["impl_source"] == "env"
+    wire = fp["halo"]["wire_bytes_per_shard"]
+    assert wire["pallas_p2p"] == wire["ppermute"]
+    assert ex["operand_bytes_per_shard"] < ex["a2a_operand_bytes_per_shard"]
+    p2p = ex["pallas_p2p"]
+    assert p2p["tiles"] == len(plan.halo_deltas)
+    assert p2p["exposed_us"] <= p2p["serial_us"]
+    assert p2p["hidden_us"] >= 0
+    # headline HBM billing matches ppermute's (2*n + W) streams — the
+    # tuner must not be handed a saving the reverse leg never delivers;
+    # the fused-forward figure is strictly smaller and reported apart
+    cfg.set_flags(halo_impl="ppermute")
+    fp_pp = plan_footprint(plan, "bfloat16", 32)
+    assert (
+        ex["hbm_bytes_per_shard"]
+        == fp_pp["collectives"]["halo_exchange"]["hbm_bytes_per_shard"]
+    )
+    assert p2p["fwd_fused_hbm_bytes_per_shard"] < ex["hbm_bytes_per_shard"]
+
+
+def test_serve_zero_recompile_with_p2p_record(rng, impl_flags):
+    """A serve engine whose forward routes the pallas_p2p lowering (as an
+    adopted record would pin it) AOT-warms its bucket and then serves
+    with ZERO steady-state compiles — the adoption surface the tuner
+    hands records to."""
+    from dgraph_tpu.comm import Communicator
+    from dgraph_tpu.models import GCN
+    from dgraph_tpu.serve.bucketing import BucketLadder
+    from dgraph_tpu.serve.engine import ServeEngine
+    from dgraph_tpu.train.loop import init_params
+
+    W, V = 2, 48
+    _, part, plan, layout = _case(rng, W, V)
+    mesh = make_graph_mesh(ranks_per_graph=W, devices=jax.devices()[:W])
+    comm = Communicator.init_process_group("tpu", world_size=W)
+    model = GCN(hidden_features=8, out_features=4, comm=comm, num_layers=2)
+    x = shard_vertex_data(
+        rng.normal(size=(V, 8)).astype(np.float32),
+        layout.src_counts, plan.n_src_pad,
+    )
+    batch = {"x": jnp.asarray(x)}
+    # original id -> (owner rank, slot): the partition is contiguous, so
+    # the slot is the index within the rank's block
+    offsets = np.concatenate([[0], np.cumsum(np.bincount(part, minlength=W))])
+    id_rank = part
+    id_slot = (np.arange(V) - offsets[part]).astype(np.int32)
+
+    # the record's pin: tuned_halo_impl the way adopt_record sets it
+    cfg.set_flags(
+        halo_impl="auto", tuned_halo_impl="pallas_p2p", use_pallas_p2p=True
+    )
+    plan_dev = jax.tree.map(jnp.asarray, plan)
+    params = init_params(
+        model, mesh, plan_dev, {**batch, "y": None, "mask": None},
+        seed=0, batch_args=lambda b, p: (b["x"], p),
+    )
+    with jax.set_mesh(mesh):
+        engine = ServeEngine(
+            model, mesh, plan_dev, params, batch,
+            id_rank=id_rank, id_slot=id_slot,
+            ladder=BucketLadder((8,)),
+        )
+        engine.warmup()
+        for start in (0, 5, 11):
+            out = engine.infer(np.arange(start, start + 4) % V)
+            assert out.shape == (4, 4)
+        assert engine.recompiles_since_warmup() == 0
