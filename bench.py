@@ -973,15 +973,16 @@ def _supervisor_emit(state: dict, error: str, wedge=None) -> int:
 
 
 def _analysis_fallback(kind: str, module: str, budget_s: float,
-                       min_budget_s: float = 30.0):
+                       min_budget_s: float = 30.0, extra_argv=()):
     """The ONE budget-bounded subprocess helper behind every wedged-path
-    analysis fallback (``schedule_drift`` and ``cpu_scan_delta`` share it
-    — two ad-hoc spawns would fork the env-pinning/parse/disable logic).
-    Runs ``python -m <module> --bench_fallback true`` on the virtual-CPU
-    backend and returns the last JSON line whose ``kind`` matches.
-    Returns None when the remaining budget is under ``min_budget_s`` or
-    the fallbacks are disabled (``DGRAPH_BENCH_ANALYSIS_FALLBACK=0``
-    turns BOTH tiers off uniformly)."""
+    analysis fallback (``schedule_drift``, ``cpu_scan_delta``, and
+    ``hlo_drift`` share it — ad-hoc spawns would fork the
+    env-pinning/parse/disable logic). Runs ``python -m <module>
+    --bench_fallback true [extra_argv...]`` on the virtual-CPU backend and
+    returns the last JSON line whose ``kind`` matches. Returns None when
+    the remaining budget is under ``min_budget_s`` or the fallbacks are
+    disabled (``DGRAPH_BENCH_ANALYSIS_FALLBACK=0`` turns ALL tiers off
+    uniformly)."""
     if os.environ.get("DGRAPH_BENCH_ANALYSIS_FALLBACK", "1") == "0":
         return None
     if budget_s < min_budget_s:
@@ -996,7 +997,8 @@ def _analysis_fallback(kind: str, module: str, budget_s: float,
         env["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
-    argv = [sys.executable, "-m", module, "--bench_fallback", "true"]
+    argv = [sys.executable, "-m", module, "--bench_fallback", "true",
+            *extra_argv]
     try:
         p = subprocess.run(
             argv, capture_output=True, text=True, env=env,
@@ -1021,10 +1023,14 @@ def _analysis_fallback(kind: str, module: str, budget_s: float,
 def _attach_fallbacks(state: dict, remaining_s) -> dict:
     """Attach every non-null analysis tier the remaining budget allows:
     ``schedule_drift`` (trace auditor, compile-free, ROADMAP item 5 tier
-    1) then ``cpu_scan_delta`` (compile-inside-scan per-phase step-time
+    1), then ``cpu_scan_delta`` (compile-inside-scan per-phase step-time
     attribution per halo lowering, tier 2 — the piece that makes a wedged
-    round's perf trajectory non-null, obs.attribution). ``remaining_s``
-    is a callable so the second tier sees what the first actually left."""
+    round's perf trajectory non-null, obs.attribution), then
+    ``hlo_drift`` (the lowered-artifact auditor, tier 3: per-lowering
+    StableHLO collective bytes vs footprint plus the donation census —
+    drift in the artifact XLA would have compiled, visible with zero
+    chips). ``remaining_s`` is a callable so each tier sees what the
+    previous ones actually left."""
     drift = _analysis_fallback(
         "schedule_drift", "dgraph_tpu.analysis", remaining_s())
     if drift is not None:
@@ -1034,6 +1040,12 @@ def _attach_fallbacks(state: dict, remaining_s) -> dict:
         min_budget_s=45.0)
     if delta is not None:
         state["cpu_scan_delta"] = delta
+    hlo = _analysis_fallback(
+        "hlo_drift", "dgraph_tpu.analysis", remaining_s(),
+        min_budget_s=45.0,
+        extra_argv=("--fallback_kind", "hlo_drift"))
+    if hlo is not None:
+        state["hlo_drift"] = hlo
     return state
 
 

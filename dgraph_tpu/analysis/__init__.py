@@ -20,14 +20,32 @@ artifact rather than a hope:
   ``obs.footprint`` priced (op counts AND operand bytes — the numbers the
   tuner ranks on), plus single-lowering-per-program, no host callbacks,
   fp32 accumulation, and donation consumption.
+- :mod:`dgraph_tpu.analysis.hlo` — the **lowered-artifact auditor**
+  (ISSUE 12): one tier below the jaxpr, ``jit(...).lower()`` (StableHLO —
+  never ``.compile()``) for every (program, halo lowering) pair and
+  verifies the post-lowering schedule: collective kinds/counts/
+  replica_groups vs the plan, operand bytes vs ``obs.footprint``, **no
+  XLA-materialized collective the plan didn't schedule** (the accidental
+  all-gather class the ``pallas_p2p`` relaxed replication checker can no
+  longer catch), one transport family per program, and
+  ``(params, opt_state)`` donation surviving lowering as donor/alias
+  entries.
+- :mod:`dgraph_tpu.analysis.kernel` — the **Pallas DMA-discipline
+  verifier**: static rules over the ``pallas_p2p`` transport kernel's
+  jaxpr (every ``dma_start`` paired with send+recv waits, nothing
+  outstanding at exit, wait-before-reuse on the double-buffer slots,
+  VMEM staging within the fused-mask budget, destination rows provably
+  ``[me*S, (me+1)*S)``).
 - :mod:`dgraph_tpu.analysis.lint` — the **contract linter**: stdlib-``ast``
   rules over the source tree (jax-free modules, no config reads in traced
-  bodies, custom_vjp pairing, named_scope on collectives, deterministic
-  plan builds), with a small registry so new contracts are one rule away.
+  bodies — pallas kernel bodies included, custom_vjp pairing, named_scope
+  on collectives, shard_map check kwargs routed through
+  ``shard_map_checks``, deterministic plan builds), with a small registry
+  so new contracts are one rule away.
 
 CLI::
 
-    python -m dgraph_tpu.analysis              # lint the tree + audit
+    python -m dgraph_tpu.analysis              # lint + audit (all tiers)
     python -m dgraph_tpu.analysis --selftest   # compile-free tier-1 smoke
 
 This module deliberately imports neither jax nor numpy at module level:
@@ -37,4 +55,4 @@ pin the platform/device-count env before any backend decision is made.
 
 from __future__ import annotations
 
-__all__ = ["lint", "trace"]
+__all__ = ["hlo", "kernel", "lint", "trace"]

@@ -1,22 +1,31 @@
-"""``python -m dgraph_tpu.analysis`` — trace auditor + contract linter CLI.
+"""``python -m dgraph_tpu.analysis`` — static-analysis CLI: contract
+linter + trace auditor + lowered-artifact (StableHLO) auditor + Pallas
+DMA-discipline verifier.
 
-Default mode lints the whole ``dgraph_tpu`` tree and trace-audits the
-canonical 2-shard workload under every halo lowering, printing one JSON
-line and exiting nonzero on any finding or drift — the pre-merge gate
-``scripts/check.py`` wraps.
+Default mode lints the whole ``dgraph_tpu`` tree and audits the canonical
+2-shard workload under every halo lowering at BOTH verification tiers —
+the jaxpr-level trace audit and the post-lowering HLO audit (plus the
+``pallas_p2p`` kernel DMA verifier) — printing one JSON line and exiting
+nonzero on any finding or drift; the pre-merge gate ``scripts/check.py``
+wraps it.
 
 ``--selftest`` is the compile-free tier-1 registration: lint-rule fixture
 checks (every rule must fire on a violating snippet and stay quiet on a
-clean one), a clean-tree lint (the violations this PR fixed are pinned
-fixed), the 2- AND 4-shard trace audits across ``all_to_all`` /
-``ppermute`` / ``overlap`` (op counts + operand bytes pinned against
-``obs.footprint``), and vacuity guards proving the auditor still FAILS on
-a wrong lowering, wrong bytes, and a dropped donation.  Zero XLA
-compiles: everything traces abstractly.
+clean one), a clean-tree lint, the 2- AND 4-shard trace AND HLO audits
+across all four halo lowerings (op counts + operand bytes pinned against
+``obs.footprint`` at both tiers), the kernel audits, and vacuity guards
+proving each tier still FAILS on seeded drift: a wrong lowering, wrong
+bytes, a mixed program, a seeded extra all-gather, a dropped donation
+(declare- and shape-level), a dropped ``dma_wait`` (plus the other
+kernel-discipline mutants), and a raw ``shard_map`` check kwarg.  Zero
+XLA compiles: the jaxpr tier traces abstractly and the HLO tier is
+lower-only (``jit(...).lower()``; the rule ``tests/README.md``
+documents).
 
 ``--bench_fallback`` prints the compact ``schedule_drift`` record bench.py
 attaches to its JSON when no healthy chip ever comes up (ROADMAP item 5's
-non-null fallback tier).
+non-null fallback tier); ``--fallback_kind hlo_drift`` selects the
+lowered-artifact drift record instead (bench attaches both).
 
 Every exit path carries a RunHealth record; reports stream to the JSONL
 log (``--log_path``) via ExperimentLog.
@@ -53,12 +62,16 @@ jax.config.update("jax_platforms", "cpu")
 @dataclasses.dataclass
 class Config:
     """Static analysis (``--selftest`` for the compile-free tier-1 smoke;
-    ``--bench_fallback`` for the bench's schedule-drift record)."""
+    ``--bench_fallback`` for the bench's fallback records —
+    ``--fallback_kind hlo_drift`` selects the lowered-artifact tier)."""
 
     selftest: bool = False
     bench_fallback: bool = False
+    fallback_kind: str = "schedule_drift"  # or "hlo_drift"
     lint: bool = True
     audit: bool = True
+    hlo: bool = True     # lowered-artifact (StableHLO) tier
+    kernel: bool = True  # pallas_p2p DMA-discipline tier
     root: str = ""  # lint root; "" = the repo containing this package
     world: int = 2  # audit world size (default mode)
     # bench-fallback workload shape (a reduced arxiv-like graph: the
@@ -231,6 +244,93 @@ _P2P_FIXTURES = {
 }
 
 
+# pallas_call kernel bodies are traced code too — until ISSUE 12 they
+# were the trace-discipline rules' blind spot (kernels reach pallas_call
+# through a functools.partial alias, which the descent now sees through)
+_KERNEL_FIXTURES = {
+    "no-config-read-in-trace": {
+        "path": "dgraph_tpu/ops/pallas_p2p.py",
+        "bad": (
+            "import functools\n"
+            "from jax.experimental import pallas as pl\n"
+            "from dgraph_tpu import config as _cfg\n"
+            "def _kernel(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...] * (2 if _cfg.use_pallas_p2p else 1)\n"
+            "def transport(x, shape):\n"
+            "    kern = functools.partial(_kernel)\n"
+            "    return pl.pallas_call(kern, out_shape=shape)(x)\n"
+        ),
+        "good": (
+            "import functools\n"
+            "from jax.experimental import pallas as pl\n"
+            "from dgraph_tpu import config as _cfg\n"
+            "def _kernel(x_ref, o_ref, *, scale):\n"
+            "    o_ref[...] = x_ref[...] * scale\n"
+            "def transport(x, shape):\n"
+            "    scale = 2 if _cfg.use_pallas_p2p else 1\n"
+            "    kern = functools.partial(_kernel, scale=scale)\n"
+            "    return pl.pallas_call(kern, out_shape=shape)(x)\n"
+        ),
+    },
+    "no-span-in-trace": {
+        "path": "dgraph_tpu/ops/pallas_p2p.py",
+        "bad": (
+            "import functools\n"
+            "from jax.experimental import pallas as pl\n"
+            "from dgraph_tpu.obs import spans\n"
+            "def _kernel(x_ref, o_ref):\n"
+            "    with spans.span('p2p.tile', stage='exchange'):\n"
+            "        o_ref[...] = x_ref[...]\n"
+            "def transport(x, shape):\n"
+            "    kern = functools.partial(_kernel)\n"
+            "    return pl.pallas_call(kern, out_shape=shape)(x)\n"
+        ),
+        "good": (
+            "import functools\n"
+            "from jax.experimental import pallas as pl\n"
+            "from dgraph_tpu.obs import spans\n"
+            "def _kernel(x_ref, o_ref):\n"
+            "    o_ref[...] = x_ref[...]\n"
+            "def transport(x, shape):\n"
+            "    with spans.span('p2p.transport', stage='exchange'):\n"
+            "        kern = functools.partial(_kernel)\n"
+            "        return pl.pallas_call(kern, out_shape=shape)(x)\n"
+        ),
+    },
+}
+
+
+_SHARD_MAP_FIXTURES = {
+    "no-unchecked-shard-map": {
+        "path": "dgraph_tpu/train/loop.py",
+        "bad": (
+            "import jax\n"
+            "def build(body, mesh, specs):\n"
+            "    return jax.shard_map(body, mesh=mesh, in_specs=specs,\n"
+            "                         out_specs=specs, check_vma=False)\n"
+        ),
+        "good": (
+            "import jax\n"
+            "from dgraph_tpu.comm.collectives import shard_map_checks\n"
+            "def build(body, mesh, specs, plan):\n"
+            "    return jax.shard_map(body, mesh=mesh, in_specs=specs,\n"
+            "                         out_specs=specs,\n"
+            "                         **shard_map_checks(plan, 'graph'))\n"
+        ),
+    },
+}
+
+# the RELAXED_CHECKS splat spelling must fire too (the blanket escape
+# parallel/sequence.py carried before its ISSUE 12 audit)
+_SHARD_MAP_SPLAT_BAD = (
+    "import jax\n"
+    "from dgraph_tpu import compat as _compat\n"
+    "def build(body, mesh, specs):\n"
+    "    return jax.shard_map(body, mesh=mesh, in_specs=specs,\n"
+    "                         out_specs=specs, **_compat.RELAXED_CHECKS)\n"
+)
+
+
 def _check(failures, cond, msg):
     if not cond:
         failures.append(msg)
@@ -239,9 +339,12 @@ def _check(failures, cond, msg):
 def _lint_fixture_checks(failures: list) -> None:
     from dgraph_tpu.analysis import lint as L
 
-    fixture_sets = list(_FIXTURES.items()) + [
-        (name, fx) for name, fx in _P2P_FIXTURES.items()
-    ]
+    fixture_sets = (
+        list(_FIXTURES.items())
+        + list(_P2P_FIXTURES.items())
+        + list(_KERNEL_FIXTURES.items())
+        + list(_SHARD_MAP_FIXTURES.items())
+    )
     for name, fx in fixture_sets:
         rule = L.RULES[name]
         for kind, src in (("bad", fx["bad"]), ("good", fx["good"])):
@@ -262,6 +365,17 @@ def _lint_fixture_checks(failures: list) -> None:
                     f"rule {name!r} false-positived on clean code "
                     f"({fx['path']}): {got}",
                 )
+    # the **RELAXED_CHECKS splat spelling of an unchecked shard_map must
+    # fire too (keyword fixture above covers check_vma=)
+    got = L.RULES["no-unchecked-shard-map"].check(
+        "dgraph_tpu/parallel/sequence.py",
+        ast.parse(_SHARD_MAP_SPLAT_BAD),
+        _SHARD_MAP_SPLAT_BAD.splitlines(),
+    )
+    _check(
+        failures, got,
+        "no-unchecked-shard-map missed a **RELAXED_CHECKS splat",
+    )
     # pragma suppression: the bad jax-free fixture goes quiet when allowed
     src = "def poison(tree):\n    import jax  # lint: allow(jax-free-module)\n"
     got = L.RULES["jax-free-module"].check(
@@ -344,7 +458,8 @@ def _audit_vacuity_checks(failures: list, w2, w4) -> None:
         return jax.shard_map(
             body, mesh=w2.mesh,
             in_specs=(plan_in_specs(w2.plan), P(GRAPH_AXIS)),
-            out_specs=P(GRAPH_AXIS), check_vma=False,
+            out_specs=P(GRAPH_AXIS),
+            **collectives.shard_map_checks(impl="pallas_p2p"),
         )(plan, xs)
 
     mism = []
@@ -367,7 +482,105 @@ def _audit_vacuity_checks(failures: list, w2, w4) -> None:
     _check(failures, unmatched, "donation check missed dropped buffers")
 
 
+def _hlo_vacuity_checks(failures: list, w2) -> None:
+    """The lowered-artifact auditor must still FAIL on seeded drift: an
+    extra XLA-level all-gather, a dropped donation (both the declare-level
+    drop and the shape-uncovered drop), and a wrong lowering family —
+    the reds that make the HLO tier's green mean something."""
+    import warnings
+
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dgraph_tpu import config as _cfg
+    from dgraph_tpu.analysis import hlo as H
+    from dgraph_tpu.analysis.trace import _train_program
+    from dgraph_tpu.comm.collectives import shard_map_checks
+    from dgraph_tpu.comm.mesh import GRAPH_AXIS
+    from dgraph_tpu.train.loop import make_train_step
+
+    saved = (_cfg.halo_impl, _cfg.tuned_halo_impl)
+    try:
+        _cfg.set_flags(halo_impl="all_to_all", tuned_halo_impl=None)
+        fn, args = _train_program(w2)
+
+        # seeded extra all-gather: the accidental-collective class the
+        # relaxed rep checker can no longer catch must go RED at the
+        # artifact level
+        def seeded(params, opt_state, batch, plan):
+            out = fn(params, opt_state, batch, plan)
+            extra = jax.shard_map(
+                lambda x: lax.all_gather(x[0], GRAPH_AXIS),
+                mesh=w2.mesh, in_specs=(P(GRAPH_AXIS),), out_specs=P(),
+                **shard_map_checks(relax="seeded vacuity mutant"),
+            )(batch["x"])
+            return out, extra
+
+        mism: list = []
+        H._audit_one_lowering(
+            "vacuity-extra-ag", "all_to_all",
+            H.lower_program(jax.jit(seeded, donate_argnums=(0, 1)), args),
+            w2.plan_np, w2.mesh, mism,
+        )
+        _check(
+            failures,
+            any("unscheduled all_gather" in m for m in mism),
+            "HLO auditor accepted an XLA-materialized all_gather the plan "
+            "never scheduled",
+        )
+
+        # dropped donation (declare level): donate=False must leave zero
+        # donor entries in the lowered module
+        donated = len(jax.tree.leaves((w2.params, w2.opt_state)))
+        nd = make_train_step(
+            w2.model, w2.optimizer, w2.mesh, w2.plan, donate=False
+        )
+        mism = []
+        H._donation_failures(
+            H.donation_entries(H.lower_program(nd, args)), donated,
+            "vacuity-no-donate", mism,
+        )
+        _check(failures, mism, "HLO auditor missed a dropped donation")
+
+        # dropped donation (shape level): a metrics-only step donates
+        # buffers no output can cover — XLA would silently drop the alias
+        mo = jax.jit(
+            lambda p, o, b, pl: fn(p, o, b, pl)[2], donate_argnums=(0, 1)
+        )
+        mism = []
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # jax warns on unusable donations
+            H._donation_failures(
+                H.donation_entries(H.lower_program(mo, args)), donated,
+                "vacuity-uncovered", mism,
+            )
+        _check(
+            failures, mism,
+            "HLO auditor missed a donation no output type covers",
+        )
+
+        # wrong lowering family at the artifact level
+        _cfg.set_flags(halo_impl="ppermute", tuned_halo_impl=None)
+        fn2, args2 = _train_program(w2)
+        mism = []
+        H._audit_one_lowering(
+            "vacuity-family", "all_to_all", H.lower_program(fn2, args2),
+            w2.plan_np, w2.mesh, mism,
+        )
+        _check(
+            failures, mism,
+            "HLO auditor accepted a mismatched lowering family",
+        )
+    finally:
+        _cfg.set_flags(halo_impl=saved[0], tuned_halo_impl=saved[1])
+
+
 def _selftest(cfg: Config, log) -> dict:
+    from dgraph_tpu.analysis.hlo import audit_workload_hlo
+    from dgraph_tpu.analysis.kernel import (
+        audit_workload_kernels, kernel_selftest_failures,
+    )
     from dgraph_tpu.analysis.lint import run_lint
     from dgraph_tpu.analysis.trace import audit_workload, build_audit_workload
 
@@ -381,6 +594,7 @@ def _selftest(cfg: Config, log) -> dict:
     )
 
     audits = {}
+    hlo_audits = {}
     workloads = {}
     for world in (2, 4):
         w = build_audit_workload(world, seed=cfg.seed)
@@ -397,8 +611,28 @@ def _selftest(cfg: Config, log) -> dict:
             f"{world}-shard audit graph has no cross-rank traffic "
             f"(the byte pins would be vacuous)",
         )
+        # the lowered-artifact tier: same workloads, one level down —
+        # lower-only (jit(...).lower(); still zero XLA compiles)
+        hrep = audit_workload_hlo(w)
+        hlo_audits[world] = hrep
+        log.write(hrep)
+        _check(
+            failures, hrep["ok"],
+            f"{world}-shard HLO audit drifted: {hrep['failures']}",
+        )
+        # the DMA-discipline tier over the real transports
+        krep = audit_workload_kernels(w)
+        log.write(krep)
+        _check(
+            failures, krep["ok"],
+            f"{world}-shard kernel audit failed: {krep['failures']}",
+        )
 
     _audit_vacuity_checks(failures, workloads[2], workloads[4])
+    _hlo_vacuity_checks(failures, workloads[2])
+    # kernel-verifier vacuity: the seeded kernel mutations (dropped
+    # dma_wait among them) must each go RED
+    failures.extend(kernel_selftest_failures())
 
     return {
         "kind": "analysis_selftest",
@@ -412,6 +646,14 @@ def _selftest(cfg: Config, log) -> dict:
             }
             for wld, rep in audits.items()
         },
+        "hlo_audit": {
+            str(wld): {
+                "ok": rep["ok"],
+                "exchange_legs": rep["exchange_legs"],
+                "donation": rep["donation"],
+            }
+            for wld, rep in hlo_audits.items()
+        },
     }
 
 
@@ -423,12 +665,20 @@ def main(cfg: Config) -> dict:
     log = ExperimentLog(cfg.log_path, echo=False)
     try:
         if cfg.bench_fallback:
-            from dgraph_tpu.analysis.trace import schedule_drift_record
+            if cfg.fallback_kind == "hlo_drift":
+                from dgraph_tpu.analysis.hlo import hlo_drift_record
 
-            out = schedule_drift_record(
-                8, num_nodes=cfg.nodes, num_edges=cfg.edges,
-                feat_dim=cfg.feat_dim, seed=cfg.seed,
-            )
+                out = hlo_drift_record(
+                    8, num_nodes=cfg.nodes, num_edges=cfg.edges,
+                    feat_dim=cfg.feat_dim, seed=cfg.seed,
+                )
+            else:
+                from dgraph_tpu.analysis.trace import schedule_drift_record
+
+                out = schedule_drift_record(
+                    8, num_nodes=cfg.nodes, num_edges=cfg.edges,
+                    feat_dim=cfg.feat_dim, seed=cfg.seed,
+                )
             out["run_health"] = health.finish(
                 "; ".join(out["failures"]) if out["drift"] else None,
                 wedge="stage_failure" if out["drift"] else None,
@@ -463,15 +713,28 @@ def main(cfg: Config) -> dict:
                     f"{f['rule']} {f['path']}:{f['line']}"
                     for f in lint_report["findings"]
                 )
-        if cfg.audit:
-            from dgraph_tpu.analysis.trace import (
-                audit_workload, build_audit_workload,
-            )
+        if cfg.audit or cfg.hlo or cfg.kernel:
+            from dgraph_tpu.analysis.trace import build_audit_workload
 
             w = build_audit_workload(cfg.world, seed=cfg.seed)
+        if cfg.audit:
+            from dgraph_tpu.analysis.trace import audit_workload
+
             audit_report = audit_workload(w)
             out["audit"] = audit_report
             problems.extend(audit_report["failures"])
+        if cfg.hlo:
+            from dgraph_tpu.analysis.hlo import audit_workload_hlo
+
+            hlo_report = audit_workload_hlo(w)
+            out["hlo_audit"] = hlo_report
+            problems.extend(hlo_report["failures"])
+        if cfg.kernel:
+            from dgraph_tpu.analysis.kernel import audit_workload_kernels
+
+            kernel_report = audit_workload_kernels(w)
+            out["kernel_audit"] = kernel_report
+            problems.extend(kernel_report["failures"])
         out["ok"] = not problems
         out["run_health"] = health.finish(
             "; ".join(problems) if problems else None,

@@ -55,11 +55,15 @@ import re
 from typing import Callable, Optional
 
 # functions whose function-valued arguments are traced by jax: a config
-# read inside one is a trace-time read (the PR 4 hazard class)
+# read inside one is a trace-time read (the PR 4 hazard class).
+# pallas_call is one of them — the kernel body is traced like any jit
+# body, and was this linter's blind spot until the pallas_p2p transport
+# made kernels a live place for config reads/spans to hide.
 TRACING_ENTRY_POINTS = frozenset({
     "jit", "shard_map", "custom_vjp", "custom_jvp", "grad", "value_and_grad",
     "vjp", "jvp", "linearize", "scan", "while_loop", "fori_loop", "cond",
     "checkpoint", "remat", "pmap", "vmap", "make_jaxpr", "eval_shape",
+    "pallas_call",
 })
 
 # lax collectives that must appear only inside named scopes in the
@@ -287,11 +291,24 @@ def _config_aliases(tree: ast.AST) -> set:
     return aliases
 
 
+def _partial_target(call: ast.Call):
+    """The function NAME a ``functools.partial(fn, ...)`` call binds, or
+    None — pallas kernels reach ``pallas_call`` through exactly this
+    wrapper (static kwargs baked in), so the descent must see through
+    it."""
+    if _last_segment(call.func) != "partial" or not call.args:
+        return None
+    first = call.args[0]
+    return first.id if isinstance(first, ast.Name) else None
+
+
 def _traced_functions(tree: ast.AST) -> list:
     """Function nodes handed to jax tracing machinery: decorated with a
-    tracing entry point, or passed (by name or inline lambda) as an
-    argument to one."""
-    traced, by_name = [], {}
+    tracing entry point, or passed (by name, inline lambda, inline
+    ``functools.partial``, or a name bound to a partial) as an argument
+    to one. ``pallas_call`` kernels count — directly or through a
+    ``kern = functools.partial(kernel_fn, ...)`` alias."""
+    traced, by_name, partial_alias = [], {}, {}
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             by_name.setdefault(node.name, []).append(node)
@@ -299,6 +316,13 @@ def _traced_functions(tree: ast.AST) -> list:
                 target = dec.func if isinstance(dec, ast.Call) else dec
                 if _last_segment(target) in TRACING_ENTRY_POINTS:
                     traced.append(node)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            # kern = functools.partial(kernel_fn, ...) -> kern aliases it
+            fn_name = _partial_target(node.value)
+            if fn_name:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        partial_alias[t.id] = fn_name
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
@@ -309,6 +333,11 @@ def _traced_functions(tree: ast.AST) -> list:
                 traced.append(arg)
             elif isinstance(arg, ast.Name):
                 traced.extend(by_name.get(arg.id, []))
+                traced.extend(by_name.get(partial_alias.get(arg.id, ""), []))
+            elif isinstance(arg, ast.Call):
+                fn_name = _partial_target(arg)
+                if fn_name:
+                    traced.extend(by_name.get(fn_name, []))
     return traced
 
 
@@ -482,6 +511,53 @@ def check_named_scope(relpath: str, tree: ast.AST, lines: list):
                 f"public collective {node.name!r} (issues a collective at "
                 f"line {issues[0]}) is not wrapped in a named scope",
             ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# no-unchecked-shard-map
+# ---------------------------------------------------------------------------
+
+
+@rule(
+    "no-unchecked-shard-map",
+    "every shard_map call site routes its replication-check kwargs through "
+    "comm.collectives.shard_map_checks(...): a raw check_vma/check_rep "
+    "kwarg (or a blanket **RELAXED_CHECKS splat) silently disables the one "
+    "checker that catches a wrong out-spec before XLA materializes an "
+    "accidental all-gather",
+    path_matcher("dgraph_tpu/"),
+)
+def check_unchecked_shard_map(relpath: str, tree: ast.AST, lines: list):
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last_segment(node.func) != "shard_map":
+            continue
+        for kw in node.keywords:
+            if kw.arg in ("check_vma", "check_rep"):
+                findings.append(Finding(
+                    "no-unchecked-shard-map", relpath, kw.value.lineno,
+                    f"raw {kw.arg}= kwarg at a shard_map call site: route "
+                    f"check kwargs through comm.collectives."
+                    f"shard_map_checks(...) so relaxing the replication "
+                    f"checker stays one greppable, reasoned decision",
+                ))
+            elif kw.arg is None:  # **splat
+                v = kw.value
+                if (
+                    isinstance(v, ast.Call)
+                    and _last_segment(v.func) == "shard_map_checks"
+                ):
+                    continue
+                findings.append(Finding(
+                    "no-unchecked-shard-map", relpath, v.lineno,
+                    f"shard_map kwargs splatted from "
+                    f"{_dotted(v) or ast.dump(v)[:40]!r} — only "
+                    f"**shard_map_checks(...) may carry check kwargs into "
+                    f"a shard_map call",
+                ))
     return findings
 
 

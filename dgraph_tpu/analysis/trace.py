@@ -268,10 +268,13 @@ def build_audit_workload(
         bb = jax.tree.map(lambda leaf: leaf[0], b)
         return model.init(jax.random.key(seed), bb["x"], ps)
 
+    from dgraph_tpu.comm.collectives import shard_map_checks
+
     bspecs = jax.tree.map(lambda _: P(GRAPH_AXIS), batch)
     init_fn = jax.shard_map(
         init_body, mesh=mesh, in_specs=(bspecs, plan_in_specs(plan)),
-        out_specs=P(), check_vma=False,
+        out_specs=P(),
+        **shard_map_checks(relax="init outputs replicated by construction"),
     )
     params = jax.eval_shape(init_fn, batch, plan)
     optimizer = optax.adam(1e-2)

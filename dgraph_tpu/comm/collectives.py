@@ -116,16 +116,44 @@ def halo_exchange_split(x, plan: EdgePlan, axis_name) -> jax.Array:
     )
 
 
-def shard_map_checks(plan: EdgePlan, axis_name) -> dict:
-    """Extra ``jax.shard_map`` kwargs for a program whose body routes this
-    plan's halo exchange: the ``pallas_p2p`` lowering's ``pallas_call``
-    has no replication rule under jax 0.4.x's rep checker, so exactly
-    those programs relax it (``compat.RELAXED_CHECKS`` — a no-op on
-    jax >= 0.6); every other lowering keeps the checker on. Resolved once
-    at trace/build time, the same place the lowering itself is."""
+def shard_map_checks(
+    plan: Optional[EdgePlan] = None,
+    axis_name=None,
+    *,
+    impl: Optional[str] = None,
+    relax: Optional[str] = None,
+) -> dict:
+    """THE one source of ``jax.shard_map`` check kwargs — every call site
+    in the tree routes through here (enforced by the
+    ``no-unchecked-shard-map`` lint rule), so which programs run with the
+    replication checker relaxed is a single greppable decision, not a
+    sprinkle of raw ``check_vma=False``.
+
+    Three spellings:
+
+    - ``shard_map_checks(plan, axis_name)`` — resolve the halo lowering
+      once (same place the lowering itself resolves) and relax ONLY for
+      ``pallas_p2p`` programs: their ``pallas_call`` has no replication
+      rule under jax 0.4.x's rep checker (``compat.RELAXED_CHECKS`` — a
+      no-op on jax >= 0.6). Every other lowering keeps the checker on.
+    - ``shard_map_checks(impl="pallas_p2p")`` — plan-less call sites that
+      already KNOW their lowering (kernel selftests, audit scaffolding).
+    - ``shard_map_checks(relax="<why>")`` — the documented escape for
+      bodies the 0.4.x checker false-positives on regardless of lowering
+      (replicated-by-construction init outputs, ring attention's causal
+      ``lax.cond`` under AD). The reason string is mandatory and exists
+      to be read in the caller — an un-explained relaxation is exactly
+      what the lint rule forbids.
+    """
     from dgraph_tpu import compat as _compat
 
-    if axis_name is not None and resolve_plan_impl(plan, axis_name) == "pallas_p2p":
+    if relax is not None:
+        return dict(_compat.RELAXED_CHECKS)
+    if impl is None:
+        if plan is None or axis_name is None:
+            return {}
+        impl = resolve_plan_impl(plan, axis_name)
+    if impl == "pallas_p2p":
         return dict(_compat.RELAXED_CHECKS)
     return {}
 

@@ -306,9 +306,14 @@ def _selftest_failures(seed: int = 0) -> list:
         mesh = jax.make_mesh((W,), ("x",))
 
         def run(body):
+            from dgraph_tpu.comm.collectives import shard_map_checks
+
             f = jax.shard_map(
                 body, mesh=mesh, in_specs=(P("x"), P("x")),
-                out_specs=P("x"), check_vma=False,
+                out_specs=P("x"),
+                # both smoke bodies (p2p and its all_to_all oracle) share
+                # this runner, and the p2p one needs the 0.4.x relaxation
+                **shard_map_checks(impl="pallas_p2p"),
             )
             return np.asarray(jax.jit(f)(xj, mj))
 

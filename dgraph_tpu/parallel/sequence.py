@@ -376,7 +376,7 @@ def ring_attention_sharded(
         )
     if kv_mask is None:
         kv_mask = jnp.ones((q.shape[0],), jnp.float32)
-    from dgraph_tpu import compat as _compat
+    from dgraph_tpu.comm.collectives import shard_map_checks
 
     fn = shard_map(
         lambda q, k, v, m: ring_attention(
@@ -385,8 +385,11 @@ def ring_attention_sharded(
         mesh=mesh,
         in_specs=(P(axis_name),) * 4,
         out_specs=P(axis_name),
-        # out is fully sharded, so the rep checker protects nothing here —
-        # and 0.4.x's raises a false cond-branch mismatch under AD
-        **_compat.RELAXED_CHECKS,
+        # audited (ISSUE 12): the blanket RELAXED_CHECKS splat is the
+        # routed escape now — out is fully sharded, so the rep checker
+        # protects nothing here, and 0.4.x's raises a false cond-branch
+        # mismatch when AD re-traces the causal lax.cond
+        **shard_map_checks(relax="ring-attention causal cond false "
+                                 "positive under AD; out fully sharded"),
     )
     return fn(q, k, v, kv_mask)

@@ -17,6 +17,14 @@ The request id space is the caller's ORIGINAL vertex numbering: the engine
 carries the :class:`~dgraph_tpu.partition.Renumbering`-derived
 ``(rank, slot)`` map, so clients never see partition internals (the inverse
 of what ``plan.unshard_vertex_data`` does for whole tensors, per-row).
+
+The per-bucket forward is a registered audit program: the static-analysis
+CLI traces it (:mod:`dgraph_tpu.analysis.trace`) AND lowers it
+(:mod:`dgraph_tpu.analysis.hlo`, ISSUE 12) under every halo lowering —
+collective schedule, operand bytes, and the donated ``(rank_idx,
+slot_idx)`` scratch surviving lowering are all pinned against
+``obs.footprint`` with zero compiles, so a serve-path schedule regression
+is caught before any engine is ever warmed.
 """
 
 from __future__ import annotations

@@ -25,9 +25,17 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 PASSES = [
+    # default analysis = lint + ALL audit tiers (jaxpr trace, lowered
+    # StableHLO, pallas_p2p DMA discipline) on the canonical workload
     ("analysis", [sys.executable, "-m", "dgraph_tpu.analysis"]),
     ("analysis-selftest",
      [sys.executable, "-m", "dgraph_tpu.analysis", "--selftest", "true"]),
+    # Pallas DMA-discipline verifier standalone: the broken-kernel
+    # vacuity guards (dropped dma_wait & co.) plus the real-transport
+    # audit — make_jaxpr only, zero XLA compiles
+    ("kernel-verifier-selftest",
+     [sys.executable, "-m", "dgraph_tpu.analysis.kernel",
+      "--selftest", "true"]),
     ("spans-selftest",
      [sys.executable, "-m", "dgraph_tpu.obs.spans", "--selftest", "true"]),
     # sharded plan artifacts (cache format v8): manifest/shard integrity,

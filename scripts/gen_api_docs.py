@@ -110,6 +110,13 @@ SECTIONS = [
     ("Static analysis: trace auditor", "dgraph_tpu.analysis.trace",
      ["walk_eqns", "collect_collectives", "build_audit_workload",
       "audit_workload", "donation_unmatched", "schedule_drift_record"]),
+    ("Static analysis: lowered-artifact auditor", "dgraph_tpu.analysis.hlo",
+     ["lower_program", "collect_stablehlo", "audit_workload_hlo",
+      "donation_entries", "hlo_drift_record", "COLLECTIVE_HLO_OPS"]),
+    ("Static analysis: Pallas DMA-discipline verifier",
+     "dgraph_tpu.analysis.kernel",
+     ["collect_transports", "verify_transport", "audit_workload_kernels",
+      "kernel_selftest_failures"]),
     ("Static analysis: contract linter", "dgraph_tpu.analysis.lint",
      ["Finding", "Rule", "rule", "path_matcher", "lint_file", "run_lint"]),
     ("Config & flags", "dgraph_tpu.config", None),
