@@ -1029,8 +1029,12 @@ def _attach_fallbacks(state: dict, remaining_s) -> dict:
     ``hlo_drift`` (the lowered-artifact auditor, tier 3: per-lowering
     StableHLO collective bytes vs footprint plus the donation census —
     drift in the artifact XLA would have compiled, visible with zero
-    chips). ``remaining_s`` is a callable so each tier sees what the
-    previous ones actually left."""
+    chips), then ``spmd_drift`` (the cross-rank SPMD auditor, tier 4:
+    per-rank lowered-module identity + collective issue order over the
+    rank-subset plan views — whether the ranks would even AGREE on a
+    schedule, the deadlock class, visible with zero chips).
+    ``remaining_s`` is a callable so each tier sees what the previous
+    ones actually left."""
     drift = _analysis_fallback(
         "schedule_drift", "dgraph_tpu.analysis", remaining_s())
     if drift is not None:
@@ -1046,6 +1050,12 @@ def _attach_fallbacks(state: dict, remaining_s) -> dict:
         extra_argv=("--fallback_kind", "hlo_drift"))
     if hlo is not None:
         state["hlo_drift"] = hlo
+    spmd = _analysis_fallback(
+        "spmd_drift", "dgraph_tpu.analysis", remaining_s(),
+        min_budget_s=45.0,
+        extra_argv=("--fallback_kind", "spmd_drift"))
+    if spmd is not None:
+        state["spmd_drift"] = spmd
     return state
 
 

@@ -36,6 +36,14 @@ artifact rather than a hope:
   outstanding at exit, wait-before-reuse on the double-buffer slots,
   VMEM staging within the fused-mask budget, destination rows provably
   ``[me*S, (me+1)*S)``).
+- :mod:`dgraph_tpu.analysis.spmd` — the **cross-rank SPMD divergence
+  auditor** (ISSUE 13): every rank's train/eval/serve program lowered
+  from that rank's plan-shard subset view under that rank's env, then
+  proven identical — canonicalized module bytes, the program-order
+  collective issue sequence (the deadlock detector: the NCCL/NVSHMEM
+  class hangs, not errors, on schedule mismatch), per-rank live-delta
+  symmetry, and tuned-record resolution agreement — across 2/4-shard
+  worlds and both generations of a ``train/shrink.py`` transition.
 - :mod:`dgraph_tpu.analysis.lint` — the **contract linter**: stdlib-``ast``
   rules over the source tree (jax-free modules, no config reads in traced
   bodies — pallas kernel bodies included, custom_vjp pairing, named_scope
@@ -55,4 +63,4 @@ pin the platform/device-count env before any backend decision is made.
 
 from __future__ import annotations
 
-__all__ = ["hlo", "kernel", "lint", "trace"]
+__all__ = ["hlo", "kernel", "lint", "spmd", "trace"]

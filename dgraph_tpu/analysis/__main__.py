@@ -1,31 +1,42 @@
 """``python -m dgraph_tpu.analysis`` — static-analysis CLI: contract
 linter + trace auditor + lowered-artifact (StableHLO) auditor + Pallas
-DMA-discipline verifier.
+DMA-discipline verifier + cross-rank SPMD divergence auditor.
 
 Default mode lints the whole ``dgraph_tpu`` tree and audits the canonical
-2-shard workload under every halo lowering at BOTH verification tiers —
-the jaxpr-level trace audit and the post-lowering HLO audit (plus the
-``pallas_p2p`` kernel DMA verifier) — printing one JSON line and exiting
-nonzero on any finding or drift; the pre-merge gate ``scripts/check.py``
-wraps it.
+2-shard workload under every halo lowering at ALL verification tiers —
+the jaxpr-level trace audit, the post-lowering HLO audit, the
+``pallas_p2p`` kernel DMA verifier, and the cross-rank SPMD audit (every
+rank's program lowered from its own plan-shard-subset view and proven
+identical, in identical collective order) — printing one JSON line and
+exiting nonzero on any finding or drift; the pre-merge gate
+``scripts/check.py`` wraps it.
 
 ``--selftest`` is the compile-free tier-1 registration: lint-rule fixture
 checks (every rule must fire on a violating snippet and stay quiet on a
 clean one), a clean-tree lint, the 2- AND 4-shard trace AND HLO audits
 across all four halo lowerings (op counts + operand bytes pinned against
-``obs.footprint`` at both tiers), the kernel audits, and vacuity guards
-proving each tier still FAILS on seeded drift: a wrong lowering, wrong
-bytes, a mixed program, a seeded extra all-gather, a dropped donation
-(declare- and shape-level), a dropped ``dma_wait`` (plus the other
-kernel-discipline mutants), and a raw ``shard_map`` check kwarg.  Zero
-XLA compiles: the jaxpr tier traces abstractly and the HLO tier is
-lower-only (``jit(...).lower()``; the rule ``tests/README.md``
-documents).
+``obs.footprint`` at both tiers), the kernel audits, the cross-rank SPMD
+audits (2- and 4-shard worlds plus both generations of a real
+``train/shrink.py`` W -> W-1 transition), and vacuity guards proving each
+tier still FAILS on seeded drift: a wrong lowering, wrong bytes, a mixed
+program, a seeded extra all-gather, a dropped donation (declare- and
+shape-level), a dropped ``dma_wait`` (plus the other kernel-discipline
+mutants), a raw ``shard_map`` check kwarg, and the seeded SPMD
+divergences (a rank-dependent branch dropping one ppermute round on rank
+1, a swapped two-collective order, a rank-divergent tuned record).  Zero
+XLA compiles: the jaxpr tier traces abstractly and the HLO/SPMD tiers
+are lower-only (``jit(...).lower()``; jit-cache counters asserted — the
+rule ``tests/README.md`` documents).
 
 ``--bench_fallback`` prints the compact ``schedule_drift`` record bench.py
 attaches to its JSON when no healthy chip ever comes up (ROADMAP item 5's
-non-null fallback tier); ``--fallback_kind hlo_drift`` selects the
-lowered-artifact drift record instead (bench attaches both).
+non-null fallback tier); ``--fallback_kind hlo_drift`` /
+``--fallback_kind spmd_drift`` select the lowered-artifact and cross-rank
+drift records instead (bench attaches all of them).
+
+``--list_rules`` prints the lint-rule registry (name, scope, description)
+— the machine-readable source the rule-catalog table in
+``docs/static-analysis.md`` is pinned against.
 
 Every exit path carries a RunHealth record; reports stream to the JSONL
 log (``--log_path``) via ExperimentLog.
@@ -67,11 +78,13 @@ class Config:
 
     selftest: bool = False
     bench_fallback: bool = False
-    fallback_kind: str = "schedule_drift"  # or "hlo_drift"
+    fallback_kind: str = "schedule_drift"  # or "hlo_drift" / "spmd_drift"
+    list_rules: bool = False  # print the lint-rule registry and exit
     lint: bool = True
     audit: bool = True
     hlo: bool = True     # lowered-artifact (StableHLO) tier
     kernel: bool = True  # pallas_p2p DMA-discipline tier
+    spmd: bool = True    # cross-rank SPMD divergence tier
     root: str = ""  # lint root; "" = the repo containing this package
     world: int = 2  # audit world size (default mode)
     # bench-fallback workload shape (a reduced arxiv-like graph: the
@@ -194,7 +207,45 @@ _FIXTURES = {
             "    return edges[rng.permutation(len(edges))]\n"
         ),
     },
+    # trace-time SPMD divergence at its source: a rank read steering
+    # PYTHON control flow in a traced body hands every rank a different
+    # program (the deadlock class analysis.spmd audits at the artifact
+    # level). Host-side rank reads OUTSIDE the traced boundary are the
+    # sanctioned pattern (checkpoint dirs, leader logging).
+    "no-rank-branch-in-trace": {
+        "path": "dgraph_tpu/train/loop.py",
+        "bad": (
+            "import jax\n"
+            "def step(x):\n"
+            "    def body(y):\n"
+            "        if jax.process_index() == 0:\n"
+            "            return y * 2\n"
+            "        return y\n"
+            "    return jax.jit(body)(x)\n"
+        ),
+        "good": (
+            "import jax\n"
+            "def launch(x):\n"
+            "    if jax.process_index() == 0:\n"
+            "        print('leader owns the checkpoint dir')\n"
+            "    return jax.jit(lambda y: y * 2)(x)\n"
+        ),
+    },
 }
+
+# the rank-env spelling of the same divergence (os.environ[RANK_ENV_VAR]
+# slicing a traced operand) must fire too — and the pragma must suppress
+# it like any other rule
+_RANK_ENV_BRANCH_BAD = (
+    "import os\n"
+    "import jax\n"
+    "from dgraph_tpu.utils.env import RANK_ENV_VAR\n"
+    "def step(x):\n"
+    "    def body(y):\n"
+    "        r = int(os.environ[RANK_ENV_VAR])\n"
+    "        return y[r:]\n"
+    "    return jax.jit(body)(x)\n"
+)
 
 
 # the pallas_p2p kernel module gets its own fixture pair per trace-
@@ -375,6 +426,17 @@ def _lint_fixture_checks(failures: list) -> None:
     _check(
         failures, got,
         "no-unchecked-shard-map missed a **RELAXED_CHECKS splat",
+    )
+    # the rank-env slicing spelling of trace-time SPMD divergence
+    got = L.RULES["no-rank-branch-in-trace"].check(
+        "dgraph_tpu/train/loop.py",
+        ast.parse(_RANK_ENV_BRANCH_BAD),
+        _RANK_ENV_BRANCH_BAD.splitlines(),
+    )
+    _check(
+        failures, got,
+        "no-rank-branch-in-trace missed an os.environ[RANK_ENV_VAR] "
+        "slice in a traced body",
     )
     # pragma suppression: the bad jax-free fixture goes quiet when allowed
     src = "def poison(tree):\n    import jax  # lint: allow(jax-free-module)\n"
@@ -634,6 +696,14 @@ def _selftest(cfg: Config, log) -> dict:
     # dma_wait among them) must each go RED
     failures.extend(kernel_selftest_failures())
 
+    # the cross-rank SPMD tier: 2- and 4-shard worlds, both generations
+    # of a real W -> W-1 shrink, and the seeded-divergence mutants —
+    # lower-only, jit-cache counters ride the spmd summary
+    from dgraph_tpu.analysis.spmd import spmd_selftest
+
+    spmd_summary = spmd_selftest(log, seed=cfg.seed)
+    failures.extend(spmd_summary.pop("failures"))
+
     return {
         "kind": "analysis_selftest",
         "failures": failures,
@@ -654,6 +724,7 @@ def _selftest(cfg: Config, log) -> dict:
             }
             for wld, rep in hlo_audits.items()
         },
+        "spmd_audit": spmd_summary,
     }
 
 
@@ -664,12 +735,36 @@ def main(cfg: Config) -> dict:
     health = RunHealth.begin("analysis.cli")
     log = ExperimentLog(cfg.log_path, echo=False)
     try:
+        if cfg.list_rules:
+            from dgraph_tpu.analysis.lint import RULES
+
+            out = {
+                "kind": "rule_catalog",
+                "rules": [
+                    {"name": r.name, "scope": r.scope,
+                     "description": r.description}
+                    for r in sorted(RULES.values(), key=lambda r: r.name)
+                ],
+            }
+            print(json.dumps(out, indent=cfg.indent or None))
+            return out
         if cfg.bench_fallback:
             if cfg.fallback_kind == "hlo_drift":
                 from dgraph_tpu.analysis.hlo import hlo_drift_record
 
                 out = hlo_drift_record(
                     8, num_nodes=cfg.nodes, num_edges=cfg.edges,
+                    feat_dim=cfg.feat_dim, seed=cfg.seed,
+                )
+            elif cfg.fallback_kind == "spmd_drift":
+                from dgraph_tpu.analysis.spmd import spmd_drift_record
+
+                # cross-rank identity is per-rank-lowering-heavy; a
+                # reduced shape keeps the wedged round's budget (the
+                # signal — do the ranks agree at all — is structural)
+                out = spmd_drift_record(
+                    4, num_nodes=min(cfg.nodes, 1024),
+                    num_edges=min(cfg.edges, 4096),
                     feat_dim=cfg.feat_dim, seed=cfg.seed,
                 )
             else:
@@ -735,6 +830,18 @@ def main(cfg: Config) -> dict:
             kernel_report = audit_workload_kernels(w)
             out["kernel_audit"] = kernel_report
             problems.extend(kernel_report["failures"])
+        if cfg.spmd:
+            from dgraph_tpu.analysis.spmd import (
+                audit_plan_dir_spmd, build_spmd_fixture,
+            )
+
+            with tempfile.TemporaryDirectory(
+                prefix="dgraph_spmd_cli_"
+            ) as tmp:
+                build_spmd_fixture(cfg.world, tmp, seed=cfg.seed)
+                spmd_report = audit_plan_dir_spmd(tmp)
+            out["spmd_audit"] = spmd_report
+            problems.extend(spmd_report["failures"])
         out["ok"] = not problems
         out["run_health"] = health.finish(
             "; ".join(problems) if problems else None,

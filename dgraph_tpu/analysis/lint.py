@@ -54,6 +54,11 @@ import os
 import re
 from typing import Callable, Optional
 
+# jax-free and stdlib-free by contract — the linter stays importable
+# without jax anywhere (the one env-var name no-rank-branch-in-trace
+# greps for lives in the same shared home its runtime readers use)
+from dgraph_tpu.utils.env import RANK_ENV_VAR
+
 # functions whose function-valued arguments are traced by jax: a config
 # read inside one is a trace-time read (the PR 4 hazard class).
 # pallas_call is one of them — the kernel body is traced like any jit
@@ -95,17 +100,23 @@ class Rule:
     description: str
     applies: Callable[[str], bool]  # repo-relative posix path -> bool
     check: Callable[[str, ast.AST, list], list]  # (relpath, tree, lines)
+    # human-readable applies-to (what the `applies` predicate encodes) —
+    # printed by ``--list-rules`` and machine-checked against the rule
+    # catalog table in docs/static-analysis.md
+    scope: str = ""
 
 
 RULES: dict = {}
 
 
-def rule(name: str, description: str, applies):
+def rule(name: str, description: str, applies, scope: str = ""):
     """Register a rule. ``applies`` is a predicate over the repo-relative
-    posix path (use :func:`path_matcher` for prefix/suffix sets)."""
+    posix path (use :func:`path_matcher` for prefix/suffix sets);
+    ``scope`` is its human-readable rendering for ``--list-rules`` and
+    the docs table."""
 
     def deco(fn):
-        RULES[name] = Rule(name, description, applies, fn)
+        RULES[name] = Rule(name, description, applies, fn, scope)
         return fn
 
     return deco
@@ -163,6 +174,15 @@ JAX_FREE_TARGETS = (
     # liveness is the thing that must keep working while jax is wedged:
     # heartbeats/polls/barriers/rendezvous never touch an accelerator API
     "dgraph_tpu/comm/membership.py",
+    # the shared home of cross-boundary env-var names (RANK_ENV_VAR):
+    # imported by every module above, so it must never pull jax in
+    "dgraph_tpu/utils/env.py",
+    # the package __init__ the env import pays on the way in: its heavy
+    # exports (TimingReport, ExperimentLog) are PEP 562-lazy precisely so
+    # this file stays jax-free at module level — enforcing it here means
+    # a restored eager import turns every target above RED instead of
+    # silently re-poisoning them
+    "dgraph_tpu/utils/__init__.py",
 )
 
 
@@ -237,6 +257,7 @@ def _file_uses_jax_at_module_level(root: str, path: str, _seen=None) -> bool:
     "chaos/, train/supervise.py and obs/health.py must not use jax in any "
     "scope, nor import dgraph_tpu modules that use jax at module level",
     path_matcher(*JAX_FREE_TARGETS),
+    scope=", ".join(t.replace("dgraph_tpu/", "") for t in JAX_FREE_TARGETS),
 )
 def check_jax_free(relpath: str, tree: ast.AST, lines: list, root: str = ""):
     findings = []
@@ -347,6 +368,7 @@ def _traced_functions(tree: ast.AST) -> list:
     "passed to jit/shard_map/custom_vjp/... (the PR 4 mixed-lowering "
     "hazard: resolve before the trace, thread the decision through)",
     path_matcher("dgraph_tpu/"),
+    scope="dgraph_tpu/",
 )
 def check_config_read_in_trace(relpath: str, tree: ast.AST, lines: list):
     aliases = _config_aliases(tree)
@@ -401,6 +423,7 @@ PROFILER_CALLS = frozenset({"trace_to"})
     "traced body measures tracing, not execution; spans stay at host "
     "boundaries)",
     path_matcher("dgraph_tpu/"),
+    scope="dgraph_tpu/",
 )
 def check_span_in_trace(relpath: str, tree: ast.AST, lines: list):
     findings = []
@@ -437,6 +460,107 @@ def check_span_in_trace(relpath: str, tree: ast.AST, lines: list):
 
 
 # ---------------------------------------------------------------------------
+# no-rank-branch-in-trace
+# ---------------------------------------------------------------------------
+
+# call names that return this process's rank identity
+RANK_IDENTITY_CALLS = frozenset({"process_index", "rank_from_env"})
+
+
+def _rank_env_aliases(tree: ast.AST) -> set:
+    """Names bound to RANK_ENV_VAR in this file (``from dgraph_tpu.utils.
+    env import RANK_ENV_VAR [as ...]`` — chaos re-exports it too)."""
+    aliases = set()
+    for node, mod, _names in _all_imports(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        if mod in ("dgraph_tpu.utils.env", "dgraph_tpu.chaos",
+                   "dgraph_tpu.utils"):
+            for a in node.names:
+                if a.name == "RANK_ENV_VAR":
+                    aliases.add(a.asname or a.name)
+    return aliases
+
+
+def _rank_read(expr: ast.AST, env_aliases: set, cfg_aliases: set):
+    """The rank-identity read inside ``expr``, or None: a
+    ``jax.process_index()``-family call, a ``$DGRAPH_RANK`` env read (by
+    literal or by RANK_ENV_VAR alias), or a rank field on the config
+    module."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and (
+            _last_segment(sub.func) in RANK_IDENTITY_CALLS
+        ):
+            return f"'{_dotted(sub.func) or _last_segment(sub.func)}()'", sub
+        if isinstance(sub, ast.Constant) and sub.value == RANK_ENV_VAR:
+            return f"'{RANK_ENV_VAR}' environment read", sub
+        if isinstance(sub, ast.Name) and sub.id in env_aliases:
+            return f"'{sub.id}' (RANK_ENV_VAR) environment read", sub
+        if isinstance(sub, ast.Attribute) and sub.attr == "RANK_ENV_VAR":
+            return "'RANK_ENV_VAR' environment read", sub
+        if (
+            isinstance(sub, ast.Attribute)
+            and _dotted(sub.value) in cfg_aliases
+            and "rank" in sub.attr.lower()
+        ):
+            return f"config rank field '{_dotted(sub.value)}.{sub.attr}'", sub
+    return None
+
+
+def _control_flow_exprs(fn: ast.AST):
+    """Expressions that steer PYTHON control flow (or indexing) inside a
+    function body: a per-rank value here changes what gets TRACED, not
+    what gets computed — every rank builds a different program."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            yield node.test
+        elif isinstance(node, ast.For):
+            yield node.iter
+        elif isinstance(node, ast.Subscript):
+            yield node.slice
+        elif isinstance(node, ast.comprehension):
+            yield node.iter
+            yield from node.ifs
+
+
+@rule(
+    "no-rank-branch-in-trace",
+    "no DGRAPH_RANK / jax.process_index() / config rank-field read inside "
+    "Python control flow of a function passed to jit/shard_map/... — every "
+    "rank would trace a DIFFERENT program, and mismatched collective "
+    "schedules deadlock (not error) on real transports; resolve rank-"
+    "dependent decisions on the host, outside the traced boundary",
+    path_matcher("dgraph_tpu/"),
+    scope="dgraph_tpu/",
+)
+def check_rank_branch_in_trace(relpath: str, tree: ast.AST, lines: list):
+    env_aliases = _rank_env_aliases(tree)
+    cfg_aliases = _config_aliases(tree)
+    findings = []
+    seen = set()
+    for fn in _traced_functions(tree):
+        for expr in _control_flow_exprs(fn):
+            hit = _rank_read(expr, env_aliases, cfg_aliases)
+            if hit is None:
+                continue
+            why, node = hit
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                "no-rank-branch-in-trace", relpath, node.lineno,
+                f"{why} steering Python control flow inside traced "
+                f"function {getattr(fn, 'name', '<lambda>')!r} (line "
+                f"{fn.lineno}): each rank traces a different program — "
+                f"trace-time SPMD divergence, the collective-schedule "
+                f"deadlock analysis.spmd exists to catch, here at its "
+                f"source",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # custom-vjp-paired
 # ---------------------------------------------------------------------------
 
@@ -446,6 +570,7 @@ def check_span_in_trace(relpath: str, tree: ast.AST, lines: list):
     "every jax.custom_vjp declaration must have a defvjp call in the same "
     "file (an unpaired one only fails under differentiation)",
     path_matcher("dgraph_tpu/"),
+    scope="dgraph_tpu/",
 )
 def check_custom_vjp_paired(relpath: str, tree: ast.AST, lines: list):
     declared = {}  # name -> lineno
@@ -484,6 +609,7 @@ def check_custom_vjp_paired(relpath: str, tree: ast.AST, lines: list):
     "public functions in comm/collectives.py that issue a lax collective "
     "must be wrapped in a named scope (profiler attribution)",
     path_matcher("dgraph_tpu/comm/collectives.py"),
+    scope="comm/collectives.py",
 )
 def check_named_scope(relpath: str, tree: ast.AST, lines: list):
     findings = []
@@ -527,6 +653,7 @@ def check_named_scope(relpath: str, tree: ast.AST, lines: list):
     "checker that catches a wrong out-spec before XLA materializes an "
     "accidental all-gather",
     path_matcher("dgraph_tpu/"),
+    scope="dgraph_tpu/",
 )
 def check_unchecked_shard_map(relpath: str, tree: ast.AST, lines: list):
     findings = []
@@ -584,6 +711,7 @@ WALL_CLOCK_CALLS = frozenset({
         "dgraph_tpu/plan.py", "dgraph_tpu/partition.py",
         "dgraph_tpu/tune/signature.py",
     ),
+    scope="plan.py, partition.py, tune/signature.py",
 )
 def check_plan_determinism(relpath: str, tree: ast.AST, lines: list):
     findings = []
@@ -654,6 +782,7 @@ def _mentions_plan(expr: ast.AST) -> Optional[str]:
         relpath.startswith("dgraph_tpu/")
         and relpath != "dgraph_tpu/plan_shards.py"
     ),
+    scope="dgraph_tpu/ except plan_shards.py",
 )
 def check_monolithic_plan_pickle(relpath: str, tree: ast.AST, lines: list):
     findings = []

@@ -189,11 +189,9 @@ class AuditWorkload:
     serve_bucket: int = 8
 
 
-def build_audit_workload(
-    world_size: int = 2,
+def workload_from_plan(
+    plan,
     *,
-    num_nodes: int = 48,
-    num_edges: int = 300,
     feat_dim: int = 8,
     hidden: int = 16,
     num_classes: int = 4,
@@ -201,18 +199,21 @@ def build_audit_workload(
     seed: int = 0,
     compute_dtype: Optional[str] = "bfloat16",
     devices=None,
+    batch: Optional[dict] = None,
+    num_nodes: Optional[int] = None,
 ) -> AuditWorkload:
-    """Host-build the canonical audit workload: a ``world_size``-shard
-    random graph (with the interior/boundary split, so all three lowerings
-    are legal) and a bf16-compute GCN — bf16 makes the fp32-accumulation
-    check bite.  No device arrays: params/opt_state are
-    ``ShapeDtypeStruct`` trees from ``eval_shape`` and the batch is plain
-    numpy, so tracing compiles nothing."""
+    """Scaffold the audit workload around an EXISTING ``[W]``-stacked
+    plan: mesh, communicator, bf16-compute GCN, batch (zeros unless
+    given — operand values never reach a lowered artifact), and abstract
+    ``eval_shape`` params/opt_state.  The ONE builder
+    :func:`build_audit_workload` and the cross-rank spmd tier's per-rank
+    builds (:func:`dgraph_tpu.analysis.spmd.build_rank_workload`) both
+    go through, so the tiers can never audit different workload shapes.
+    Nothing here compiles and nothing touches a device buffer."""
     import numpy as np
     import jax
     import optax
 
-    from dgraph_tpu import plan as pl
     from dgraph_tpu.comm import Communicator
     from dgraph_tpu.comm.mesh import (
         GRAPH_AXIS, make_graph_mesh, plan_in_specs, squeeze_plan,
@@ -220,24 +221,16 @@ def build_audit_workload(
     from dgraph_tpu.models import GCN
     from jax.sharding import PartitionSpec as P
 
+    world_size = int(plan.world_size)
     if devices is None:
         devices = jax.devices()
     if len(devices) < world_size:
         raise ValueError(
-            f"trace audit for world_size={world_size} needs that many "
+            f"audit for world_size={world_size} needs that many "
             f"devices; have {len(devices)} (set XLA_FLAGS="
             f"--xla_force_host_platform_device_count=8 before jax's first "
             f"backend touch)"
         )
-    rng = np.random.default_rng(seed)
-    part = np.sort(rng.integers(0, world_size, num_nodes)).astype(np.int32)
-    edges = np.stack([
-        rng.integers(0, num_nodes, num_edges),
-        rng.integers(0, num_nodes, num_edges),
-    ])
-    plan, layout = pl.build_edge_plan(
-        edges, part, world_size=world_size, overlap=True
-    )
     mesh = make_graph_mesh(
         ranks_per_graph=world_size, devices=devices[:world_size]
     )
@@ -252,16 +245,13 @@ def build_audit_workload(
         hidden_features=hidden, out_features=num_classes, comm=comm,
         num_layers=num_layers, dtype=dt,
     )
-
-    x = pl.shard_vertex_data(
-        rng.normal(size=(num_nodes, feat_dim)).astype(np.float32),
-        layout.src_counts, plan.n_src_pad,
-    )
-    batch = {
-        "x": x,
-        "y": np.zeros((world_size, plan.n_src_pad), np.int32),
-        "mask": np.ones((world_size, plan.n_src_pad), np.float32),
-    }
+    n_pad = int(plan.n_src_pad)
+    if batch is None:
+        batch = {
+            "x": np.zeros((world_size, n_pad, feat_dim), np.float32),
+            "y": np.zeros((world_size, n_pad), np.int32),
+            "mask": np.ones((world_size, n_pad), np.float32),
+        }
 
     def init_body(b, p):
         ps = squeeze_plan(p)
@@ -282,7 +272,57 @@ def build_audit_workload(
     return AuditWorkload(
         model=model, optimizer=optimizer, mesh=mesh, plan=plan, plan_np=plan,
         batch=batch, params=params, opt_state=opt_state,
-        world_size=world_size, feat_dim=feat_dim, num_nodes=num_nodes,
+        world_size=world_size, feat_dim=feat_dim,
+        num_nodes=num_nodes if num_nodes is not None
+        else world_size * n_pad,
+    )
+
+
+def build_audit_workload(
+    world_size: int = 2,
+    *,
+    num_nodes: int = 48,
+    num_edges: int = 300,
+    feat_dim: int = 8,
+    hidden: int = 16,
+    num_classes: int = 4,
+    num_layers: int = 2,
+    seed: int = 0,
+    compute_dtype: Optional[str] = "bfloat16",
+    devices=None,
+) -> AuditWorkload:
+    """Host-build the canonical audit workload: a ``world_size``-shard
+    random graph (with the interior/boundary split, so all three lowerings
+    are legal) and a bf16-compute GCN — bf16 makes the fp32-accumulation
+    check bite.  No device arrays: params/opt_state are
+    ``ShapeDtypeStruct`` trees from ``eval_shape`` and the batch is plain
+    numpy, so tracing compiles nothing."""
+    import numpy as np
+
+    from dgraph_tpu import plan as pl
+
+    rng = np.random.default_rng(seed)
+    part = np.sort(rng.integers(0, world_size, num_nodes)).astype(np.int32)
+    edges = np.stack([
+        rng.integers(0, num_nodes, num_edges),
+        rng.integers(0, num_nodes, num_edges),
+    ])
+    plan, layout = pl.build_edge_plan(
+        edges, part, world_size=world_size, overlap=True
+    )
+    x = pl.shard_vertex_data(
+        rng.normal(size=(num_nodes, feat_dim)).astype(np.float32),
+        layout.src_counts, plan.n_src_pad,
+    )
+    batch = {
+        "x": x,
+        "y": np.zeros((world_size, plan.n_src_pad), np.int32),
+        "mask": np.ones((world_size, plan.n_src_pad), np.float32),
+    }
+    return workload_from_plan(
+        plan, feat_dim=feat_dim, hidden=hidden, num_classes=num_classes,
+        num_layers=num_layers, seed=seed, compute_dtype=compute_dtype,
+        devices=devices, batch=batch, num_nodes=num_nodes,
     )
 
 

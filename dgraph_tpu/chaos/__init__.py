@@ -79,11 +79,10 @@ ENV_VAR = "DGRAPH_CHAOS"
 # restart budget away)
 ATTEMPT_ENV_VAR = "DGRAPH_CHAOS_ATTEMPT"
 # the group supervisor's member ordinal (``supervise_group`` exports it to
-# each rank child) — shared group identity, not chaos-owned: workers read
-# it to know which plan shard/checkpoint block is theirs, and a chaos
-# clause's ``rank=K`` param matches against it so one spec can kill
-# exactly one member of a multi-rank launch
-RANK_ENV_VAR = "DGRAPH_RANK"
+# each rank child) — shared group identity, not chaos-owned (the constant
+# lives in the jax-free ``dgraph_tpu.utils.env``; re-exported here because
+# chaos is where a clause's ``rank=K`` param matches against it)
+from dgraph_tpu.utils.env import RANK_ENV_VAR  # noqa: E402
 
 # point name -> where it is consulted (documentation + typo guard: a spec
 # naming an unknown point is rejected at parse time, not silently inert)

@@ -64,11 +64,30 @@ from typing import Callable, Optional
 
 import dgraph_tpu.obs.spans as spans  # stdlib-only module (lint-enforced)
 from dgraph_tpu import chaos
+from dgraph_tpu.utils.env import RANK_ENV_VAR
 
 # a survivor that detected rank loss exits with this code after saving its
 # checkpoint; supervise_group treats it as "shrink the world and resume"
 # (the membership analog of train.elastic.WEDGED_EXIT_CODE == 17)
 RANK_LOST_EXIT_CODE = 19
+
+
+def rank_from_env(default: Optional[int] = None) -> int:
+    """The member ordinal ``supervise_group`` exported to this process
+    (``$DGRAPH_RANK`` — :data:`dgraph_tpu.utils.env.RANK_ENV_VAR`).  The
+    canonical way an elastic worker learns which plan shard / checkpoint
+    block / membership slot is its own.  Raises when unset and no
+    ``default`` is given: a worker silently assuming rank 0 would fight
+    the real rank 0 over its lease file."""
+    raw = os.environ.get(RANK_ENV_VAR, "").strip()
+    if raw:
+        return int(raw)
+    if default is None:
+        raise RuntimeError(
+            f"{RANK_ENV_VAR} is not set: this process was not launched by "
+            f"supervise_group (pass rank= explicitly, or export it)"
+        )
+    return int(default)
 
 _MEMBER_PREFIX = "member_"
 _LEFT_PREFIX = "left_"

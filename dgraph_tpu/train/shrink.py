@@ -147,6 +147,7 @@ def init_world(
     partition_method: str = "block",
     seed: int = 0,
     pad_multiple: int = 8,
+    overlap: bool = False,
     lease_s: float = 5.0,
     heartbeat_interval_s: Optional[float] = None,
     memory_budget_bytes: Optional[int] = None,
@@ -173,6 +174,7 @@ def init_world(
     build_plan_shards(
         new_edges, ren.partition, out_dir=plan_dir(run_dir, 0),
         world_size=world_size, pad_multiple=pad_multiple,
+        overlap=overlap or None,
         write_layout=False, memory_budget_bytes=memory_budget_bytes,
     )
     rec = {
@@ -183,6 +185,10 @@ def init_world(
         "lease_s": float(lease_s),
         "heartbeat_interval_s": heartbeat_interval_s,
         "pad_multiple": int(pad_multiple),
+        # plan-build knobs every later generation must REPLAY: a shrink
+        # that rebuilt without the interior/boundary split would silently
+        # outlaw the overlap/pallas_p2p lowerings in the degraded world
+        "plan_overlap": bool(overlap),
         "lost_history": [],
     }
     write_world(run_dir, rec)
@@ -319,6 +325,7 @@ def shrink_world(run_dir: str, lost_ranks) -> dict:
                         out_dir=plan_dir(run_dir, new_gen),
                         world_size=new_world,
                         pad_multiple=int(world.get("pad_multiple", 8)),
+                        overlap=world.get("plan_overlap", False) or None,
                         write_layout=False,
                     )
                 except BaseException as e:  # re-raised on join

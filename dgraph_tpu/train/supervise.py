@@ -51,12 +51,13 @@ if __name__ == "_dgraph_train_supervise":  # standalone (bench supervisor)
     spans = sys.modules["_dgraph_obs_spans"]
     WEDGED_EXIT_CODE = 17  # train.elastic.WEDGED_EXIT_CODE
     ATTEMPT_ENV_VAR = "DGRAPH_CHAOS_ATTEMPT"  # chaos.ATTEMPT_ENV_VAR
-    RANK_ENV_VAR = "DGRAPH_RANK"  # chaos.RANK_ENV_VAR
+    RANK_ENV_VAR = "DGRAPH_RANK"  # utils.env.RANK_ENV_VAR
     RANK_LOST_EXIT_CODE = 19  # comm.membership.RANK_LOST_EXIT_CODE
 else:
     import dgraph_tpu.obs.spans as spans  # jax-free (lint-enforced)
-    from dgraph_tpu.chaos import ATTEMPT_ENV_VAR, RANK_ENV_VAR
+    from dgraph_tpu.chaos import ATTEMPT_ENV_VAR
     from dgraph_tpu.comm.membership import RANK_LOST_EXIT_CODE
+    from dgraph_tpu.utils.env import RANK_ENV_VAR
     from dgraph_tpu.train.elastic import WEDGED_EXIT_CODE
 
 

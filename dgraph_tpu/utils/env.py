@@ -1,0 +1,29 @@
+"""Shared process-identity environment-variable names.
+
+**jax-free by contract** (``analysis.lint``'s ``jax-free-module`` rule):
+this module is the one home of the env-var names that cross the
+jax-free / jax-using boundary.  ``train/supervise.py`` exports
+``DGRAPH_RANK`` to each child of a multi-rank group; ``chaos`` matches a
+clause's ``rank=K`` against it; ``comm.membership`` workers read their
+member ordinal from it; and ``analysis.lint``'s
+``no-rank-branch-in-trace`` rule greps for it inside traced code.  Before
+this module, ``train/supervise.py`` hand-copied the literal (it must stay
+importable standalone — see its header) — the copies are pinned equal in
+``tests/test_plan_shards.py`` so the strings can never drift.
+
+Stdlib-free on purpose: importing this file can never pull in a backend,
+a third-party package, or anything a wedged lease could hang.
+"""
+
+from __future__ import annotations
+
+# The group supervisor's member ordinal (``supervise_group`` exports it to
+# each rank child). Shared group identity: workers read it to know which
+# plan shard / checkpoint block is theirs; a chaos clause's ``rank=K``
+# matches against it. NEVER read it inside a traced function — that is
+# trace-time SPMD divergence, the class ``analysis.spmd`` exists to catch
+# (``analysis.lint``'s ``no-rank-branch-in-trace`` flags it at the
+# source).
+RANK_ENV_VAR = "DGRAPH_RANK"
+
+__all__ = ["RANK_ENV_VAR"]

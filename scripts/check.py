@@ -7,6 +7,9 @@ with no chip at all) and exits nonzero if ANY pass fails:
 
     python scripts/check.py            # lint + audit + analysis selftest
     python scripts/check.py --all      # also the chaos/tune/serve selftests
+    python scripts/check.py --jobs 4   # fan the independent selftest
+                                       # subprocesses out 4 wide (default
+                                       # stays serial)
 
 Intended as the pre-merge gate and as the cheap first half of a bench
 round: everything here is compile-free (abstract tracing only), so a full
@@ -52,6 +55,13 @@ PASSES = [
     # compiles only — the kernels never dial an accelerator here)
     ("pallas-p2p-selftest",
      [sys.executable, "-m", "dgraph_tpu.ops.pallas_p2p",
+      "--selftest", "true"]),
+    # cross-rank SPMD divergence auditor standalone: per-rank lowered-
+    # module identity + collective issue order on 2/4-shard worlds and a
+    # real shrink transition, plus the seeded-divergence vacuity mutants
+    # — lower-only, zero XLA compiles
+    ("spmd-selftest",
+     [sys.executable, "-m", "dgraph_tpu.analysis.spmd",
       "--selftest", "true"]),
 ]
 
@@ -105,16 +115,31 @@ def main() -> int:
                     help="also run the chaos/tune/serve CLI selftests")
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-pass timeout in seconds")
+    ap.add_argument("--jobs", type=int, default=1,
+                    help="run up to N selftest subprocesses concurrently "
+                         "(every pass is an independent subprocess; the "
+                         "serial default keeps tier-1 timing unchanged)")
     args = ap.parse_args()
 
     passes = PASSES + (EXTRA_SELFTESTS if args.all else [])
     results = []
-    for name, argv in passes:
-        print(f"[check] {name}: {' '.join(argv[1:])}", flush=True)
-        res = run_pass(name, argv, args.timeout)
-        print(f"[check] {name}: {'OK' if res['ok'] else 'FAILED'}"
-              + (f" — {res['detail']}" if not res["ok"] else ""), flush=True)
-        results.append(res)
+    # the passes are independent subprocesses by construction — fan them
+    # out --jobs wide (max_workers=1 reproduces the serial gate exactly),
+    # PRINTING in submission order so logs stay stable either way
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=max(1, args.jobs)) as pool:
+        futures = [
+            (name, argv, pool.submit(run_pass, name, argv, args.timeout))
+            for name, argv in passes
+        ]
+        for name, argv, fut in futures:
+            print(f"[check] {name}: {' '.join(argv[1:])}", flush=True)
+            res = fut.result()
+            print(f"[check] {name}: {'OK' if res['ok'] else 'FAILED'}"
+                  + (f" — {res['detail']}" if not res["ok"] else ""),
+                  flush=True)
+            results.append(res)
     ok = all(r["ok"] for r in results)
     print(json.dumps({"kind": "check_report", "ok": ok, "passes": results}))
     return 0 if ok else 1

@@ -117,6 +117,13 @@ SECTIONS = [
      "dgraph_tpu.analysis.kernel",
      ["collect_transports", "verify_transport", "audit_workload_kernels",
       "kernel_selftest_failures"]),
+    ("Static analysis: cross-rank SPMD divergence auditor",
+     "dgraph_tpu.analysis.spmd",
+     ["build_spmd_fixture", "build_shrink_fixture", "build_rank_workload",
+      "rank_live_deltas", "canonical_module_text",
+      "canonicalize_rank_modules", "collective_sequence",
+      "resolution_agreement", "audit_plan_dir_spmd", "spmd_drift_record",
+      "spmd_selftest"]),
     ("Static analysis: contract linter", "dgraph_tpu.analysis.lint",
      ["Finding", "Rule", "rule", "path_matcher", "lint_file", "run_lint"]),
     ("Config & flags", "dgraph_tpu.config", None),

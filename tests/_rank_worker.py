@@ -56,13 +56,14 @@ def main() -> None:
     run_dir, num_steps, sleep_s = (
         sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
     )
-    rank = int(os.environ["DGRAPH_RANK"])
-
     from dgraph_tpu.comm.membership import (
         RANK_LOST_EXIT_CODE,
         Membership,
         RankLostError,
+        rank_from_env,
     )
+
+    rank = rank_from_env()
     from dgraph_tpu.plan import load_sharded_plan
     from dgraph_tpu.train import shrink
     from dgraph_tpu.train.checkpoint import latest_step, restore_checkpoint

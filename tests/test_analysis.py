@@ -150,6 +150,107 @@ def test_pragma_suppression_requires_matching_rule():
     assert _run_rule("jax-free-module", "dgraph_tpu/chaos/x.py", wrong)
 
 
+def test_rank_branch_in_trace_rule():
+    """Rank-identity reads steering Python control flow inside a traced
+    body = trace-time SPMD divergence; host-side rank reads outside the
+    traced boundary are the sanctioned pattern."""
+    bad = (
+        "import jax\n"
+        "def step(x):\n"
+        "    def body(y):\n"
+        "        if jax.process_index() == 0:\n"
+        "            return y * 2\n"
+        "        return y\n"
+        "    return jax.jit(body)(x)\n"
+    )
+    got = _run_rule("no-rank-branch-in-trace", "dgraph_tpu/train/loop.py", bad)
+    assert len(got) == 1 and "process_index" in got[0].message
+
+    good = (
+        "import jax\n"
+        "def launch(x):\n"
+        "    if jax.process_index() == 0:\n"
+        "        print('leader')\n"
+        "    return jax.jit(lambda y: y * 2)(x)\n"
+    )
+    assert not _run_rule(
+        "no-rank-branch-in-trace", "dgraph_tpu/train/loop.py", good
+    )
+
+    # the env-var spelling, through the shared RANK_ENV_VAR constant
+    env_bad = (
+        "import os\n"
+        "import jax\n"
+        "from dgraph_tpu.utils.env import RANK_ENV_VAR\n"
+        "def step(x):\n"
+        "    def body(y):\n"
+        "        return y[int(os.environ[RANK_ENV_VAR]):]\n"
+        "    return jax.jit(body)(x)\n"
+    )
+    assert _run_rule(
+        "no-rank-branch-in-trace", "dgraph_tpu/train/loop.py", env_bad
+    )
+    # pragma suppression works like every other rule
+    suppressed = env_bad.replace(
+        "        return y[int(os.environ[RANK_ENV_VAR]):]\n",
+        "        # lint: allow(no-rank-branch-in-trace)\n"
+        "        return y[int(os.environ[RANK_ENV_VAR]):]\n",
+    )
+    assert not _run_rule(
+        "no-rank-branch-in-trace", "dgraph_tpu/train/loop.py", suppressed
+    )
+
+
+# ---------------------------------------------------------------------------
+# rule registry: --list-rules CLI + the docs table pin
+# ---------------------------------------------------------------------------
+
+
+def test_list_rules_cli_prints_the_registry():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dgraph_tpu.analysis", "--list_rules", "true"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["kind"] == "rule_catalog"
+    listed = {r["name"] for r in rec["rules"]}
+    assert listed == set(L.RULES)
+    for row in rec["rules"]:
+        assert row["description"] == L.RULES[row["name"]].description
+        assert row["scope"] == L.RULES[row["name"]].scope
+        assert row["scope"], f"rule {row['name']} has no scope string"
+
+
+def test_docs_rule_catalog_matches_registry():
+    """The rule-catalog table in docs/static-analysis.md is maintained by
+    hand; after three analysis PRs it can silently drift from the RULES
+    registry — machine-check one against the other."""
+    path = os.path.join(REPO, "docs", "static-analysis.md")
+    text = open(path).read()
+    # table rows look like: | `rule-name` | scope | contract |
+    documented = set()
+    for line in text.splitlines():
+        m = line.strip().startswith("| `")
+        if not m:
+            continue
+        cell = line.strip().split("|")[1].strip()
+        if cell.startswith("`") and cell.endswith("`"):
+            name = cell.strip("`")
+            if name in L.RULES or "-" in name:
+                documented.add(name)
+    undocumented = set(L.RULES) - documented
+    assert not undocumented, (
+        f"rules missing from the docs/static-analysis.md catalog table: "
+        f"{sorted(undocumented)}"
+    )
+    ghost = {d for d in documented if d not in L.RULES}
+    assert not ghost, (
+        f"docs/static-analysis.md documents rules the registry does not "
+        f"have: {sorted(ghost)}"
+    )
+
+
 # ---------------------------------------------------------------------------
 # trace auditor (abstract tracing only — no compiles)
 # ---------------------------------------------------------------------------

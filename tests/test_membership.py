@@ -266,6 +266,23 @@ def test_barrier_fails_fast_on_rank_loss(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# rank identity from the supervisor's env export
+# ---------------------------------------------------------------------------
+
+
+def test_rank_from_env(monkeypatch):
+    from dgraph_tpu.utils.env import RANK_ENV_VAR
+
+    monkeypatch.setenv(RANK_ENV_VAR, "3")
+    assert ms.rank_from_env() == 3
+    assert ms.rank_from_env(default=0) == 3  # env wins over the default
+    monkeypatch.delenv(RANK_ENV_VAR)
+    assert ms.rank_from_env(default=2) == 2
+    with pytest.raises(RuntimeError):  # silent rank-0 would fight rank 0
+        ms.rank_from_env()
+
+
+# ---------------------------------------------------------------------------
 # CLI selftest (tier-1 registration)
 # ---------------------------------------------------------------------------
 

@@ -341,6 +341,68 @@ def test_load_rank_subset_and_multihost_path(tmp_path):
     assert np.array_equal(plan.dst_index[0], full.dst_index[2])
 
 
+@pytest.mark.parametrize("w", [2, 4])
+def test_rank_subset_view_bit_identical_to_full_world_slice(tmp_path, w):
+    """The substrate assumption of the cross-rank SPMD auditor
+    (``analysis.spmd``): ``assemble_plan(load_sharded_plan(ranks=[r]))``
+    yields per-rank rows BIT-identical to slicing the full-world plan,
+    for EVERY rank — a subset view that disagreed with the full world on
+    any array row or any static would make per-rank program builds
+    diverge by construction."""
+    import dataclasses
+
+    from dgraph_tpu.plan import build_plan_shards, load_sharded_plan
+
+    edges, part, _ = _graph(seed=3, w=w)
+    d = str(tmp_path / f"shards_w{w}")
+    build_plan_shards(
+        edges, part, out_dir=d, world_size=w, overlap=True,
+        write_layout=False,
+    )
+    full, _ = load_sharded_plan(d, load_layout=False)
+
+    def leaves(plan):
+        out = {}
+        for f in dataclasses.fields(plan):
+            v = getattr(plan, f.name)
+            if isinstance(v, np.ndarray):
+                out[f.name] = v
+        for f in dataclasses.fields(plan.halo):
+            v = getattr(plan.halo, f.name)
+            if isinstance(v, np.ndarray):
+                out[f"halo.{f.name}"] = v
+        if plan.overlap is not None:
+            for f in dataclasses.fields(plan.overlap):
+                v = getattr(plan.overlap, f.name)
+                if isinstance(v, np.ndarray):
+                    out[f"overlap.{f.name}"] = v
+        return out
+
+    full_leaves = leaves(full)
+    assert full_leaves, "no array leaves found — the comparison is vacuous"
+    for r in range(w):
+        sub, layout = load_sharded_plan(d, ranks=[r], load_layout=False)
+        assert layout is None
+        sub_leaves = leaves(sub)
+        assert set(sub_leaves) == set(full_leaves)
+        for name, leaf in sub_leaves.items():
+            assert leaf.shape[0] == 1, (r, name)
+            assert leaf.dtype == full_leaves[name].dtype, (r, name)
+            assert np.array_equal(leaf[0], full_leaves[name][r]), (r, name)
+        # every static the program build consumes must describe the FULL
+        # world, not the subset
+        assert sub.world_size == full.world_size == w
+        for field in ("n_src_pad", "n_dst_pad", "e_pad", "halo_side",
+                      "homogeneous", "owner_sorted", "halo_deltas",
+                      "scatter_mc", "scatter_block_e", "scatter_block_n"):
+            assert getattr(sub, field) == getattr(full, field), (r, field)
+        assert sub.halo.s_pad == full.halo.s_pad
+        assert (sub.overlap is None) == (full.overlap is None)
+        if sub.overlap is not None:
+            assert sub.overlap.e_int_pad == full.overlap.e_int_pad
+            assert sub.overlap.e_bnd_pad == full.overlap.e_bnd_pad
+
+
 def test_write_layout_opt_out(tmp_path):
     """write_layout=False skips the O(E) layout sidecar entirely — at
     papers100M scale it pickles to ~25 GB and nothing in the per-host
@@ -620,6 +682,17 @@ def test_supervise_standalone_twin_contract():
         assert twin.RANK_ENV_VAR == chaos.RANK_ENV_VAR
         assert twin.RANK_LOST_EXIT_CODE == RANK_LOST_EXIT_CODE == 19
         assert pkg.WEDGED_EXIT_CODE == twin.WEDGED_EXIT_CODE
+        # the constant's canonical home (dgraph_tpu/utils/env.py, jax-free
+        # by lint contract): every consumer — chaos's rank=K matcher, the
+        # supervisor's export, membership's rank_from_env, the twin's
+        # literal fallback — must carry the SAME string
+        from dgraph_tpu.comm import membership
+        from dgraph_tpu.utils.env import RANK_ENV_VAR
+
+        assert (
+            RANK_ENV_VAR == chaos.RANK_ENV_VAR == pkg.RANK_ENV_VAR
+            == membership.RANK_ENV_VAR == twin.RANK_ENV_VAR == "DGRAPH_RANK"
+        )
         # the twin's supervise() runs end to end without the package
         lineage = twin.supervise(
             [sys.executable, "-c", "import sys; sys.exit(0)"],
