@@ -183,6 +183,16 @@ JAX_FREE_TARGETS = (
     # a restored eager import turns every target above RED instead of
     # silently re-poisoning them
     "dgraph_tpu/utils/__init__.py",
+    # serving control-plane bookkeeping: the model registry, tenant
+    # quota table, and structured serve errors are inspected by the
+    # supervisor and health tooling in processes that never dial a
+    # backend — and the serve package __init__ is PEP 562-lazy for the
+    # same reason utils' is (an eager engine import here would poison
+    # all three)
+    "dgraph_tpu/serve/__init__.py",
+    "dgraph_tpu/serve/errors.py",
+    "dgraph_tpu/serve/registry.py",
+    "dgraph_tpu/serve/tenancy.py",
 )
 
 

@@ -109,6 +109,18 @@ KNOWN_POINTS = {
                       "each lease write (index=seq)",
     "comm.rendezvous": "comm/membership.py::Membership.rendezvous, per "
                        "join attempt (index=attempt)",
+    # serving control plane (serve/rollover.py + serve/deltas.py): a
+    # 'raise' on serve.swap proves rollback-to-prior-params with zero
+    # dropped in-flight requests; a 'sigterm' on serve.replan (fired at
+    # entry AND at the commit boundary after artifacts are durable but
+    # before the pointer flips) proves generation adoption is atomic —
+    # old or new adopted, never torn
+    "serve.swap": "serve/rollover.py::swap_params, between checkpoint "
+                  "staging and validation (the mid-swap rollback window)",
+    "serve.delta_append": "serve/deltas.py::append_delta entry, before "
+                          "the staged write",
+    "serve.replan": "serve/deltas.py::replan — consulted at entry and "
+                    "again at the pre-pointer-flip commit boundary",
 }
 
 ACTIONS = ("raise", "wedge", "sigterm", "poison", "delay")

@@ -119,6 +119,33 @@ def _selftest() -> dict:
             f"plan.write fired at {plan_fired}, want [1]",
         )
 
+        # --- serving control-plane points (serve/rollover.py + deltas.py):
+        # registered, parseable, and firing like any host boundary ---
+        for pt in ("serve.swap", "serve.delta_append", "serve.replan"):
+            _check(
+                failures, pt in chaos.KNOWN_POINTS,
+                f"serve point {pt!r} missing from KNOWN_POINTS",
+            )
+            (cl,) = chaos.parse_spec(f"{pt}=raise@0")
+            _check(
+                failures, cl.point == pt and cl.action == "raise",
+                f"serve point clause misparsed: {cl}",
+            )
+        # the replan commit-boundary clause: replan consults the point
+        # TWICE per call (entry, then pre-flip), so sigterm@1 is the
+        # torn-window injection — prove index-1 gating fires exactly there
+        chaos.arm("serve.replan=raise@1")
+        replan_fired = []
+        for i in range(3):
+            try:
+                chaos.fire("serve.replan")
+            except chaos.ChaosFault:
+                replan_fired.append(i)
+        _check(
+            failures, replan_fired == [1],
+            f"serve.replan fired at {replan_fired}, want [1]",
+        )
+
         # --- membership points (comm/membership.py): registered, parseable,
         # firing like any host boundary ---
         for pt in ("comm.heartbeat", "comm.rendezvous"):

@@ -3,7 +3,8 @@
 The training stack ends at ``make_eval_step``; this package is the path
 from a checkpoint to request/response inference at production latency on
 TPU, built on the one discipline that matters there — **no XLA compiles on
-the hot path**:
+the hot path** — plus the control plane that keeps a fleet serving through
+change:
 
 - :mod:`~dgraph_tpu.serve.bucketing` — requests are padded up a small
   geometric ladder of target-node-count buckets (:class:`BucketLadder`),
@@ -16,37 +17,87 @@ the hot path**:
 - :mod:`~dgraph_tpu.serve.batcher` — :class:`MicroBatcher` coalesces
   concurrent requests into one padded call: bounded queue with structured
   backpressure (:class:`~dgraph_tpu.serve.errors.QueueFull`), bounded batch
-  delay, per-request deadlines.
+  delay, per-request deadlines, per-tenant admission.
+- :mod:`~dgraph_tpu.serve.rollover` — hot-swap checkpoint rollover under
+  the same warmed executables (:meth:`ServeEngine.swap_params`): zero
+  recompiles, per-batch atomic, automatic rollback on a bad checkpoint.
+- :mod:`~dgraph_tpu.serve.registry` — :class:`ModelRegistry`: named
+  model/graph versions behind one batcher, activated atomically between
+  batches.
+- :mod:`~dgraph_tpu.serve.tenancy` — :class:`TenantTable`: token-bucket
+  rate quotas, bounded queue shares, per-tenant degraded shedding.
+- :mod:`~dgraph_tpu.serve.deltas` — live graph growth: pad-slot vertex
+  appends, background streaming re-plan, atomic generation-pointer
+  adoption.
 - :mod:`~dgraph_tpu.serve.health` — the ``serve_health`` JSONL record
-  (latency percentiles, queue state, recompile counter) riding the
-  :mod:`dgraph_tpu.obs` pipeline.
+  (latency percentiles, queue/tenant state, lineage, recompile counter)
+  riding the :mod:`dgraph_tpu.obs` pipeline.
+
+Module-level imports here are LAZY (PEP 562 ``__getattr__``) on purpose:
+the control-plane bookkeeping (``registry``/``tenancy``/``errors``) is
+under the ``jax-free-module`` lint contract so the train supervisor and
+health tooling can import it in processes that never dial a backend — an
+eager ``from dgraph_tpu.serve.engine import ServeEngine`` here would drag
+jax into every one of those imports. Call sites keep working unchanged
+through the lazy hook.
 
 CLI: ``python -m dgraph_tpu.serve --selftest`` is the single-process CPU
-end-to-end check; ``experiments/serve_bench.py`` is the closed-loop load
-generator.
+end-to-end check (traffic + hot-swap + quota paths, compile-free);
+``experiments/serve_bench.py`` is the load generator (closed-loop and
+multi-tenant open-loop).
 """
 
-from dgraph_tpu.serve.batcher import MicroBatcher
-from dgraph_tpu.serve.bucketing import BucketLadder, pad_ids
-from dgraph_tpu.serve.engine import ServeEngine
-from dgraph_tpu.serve.errors import (
-    EngineStopped,
-    QueueFull,
-    RequestTimeout,
-    RequestTooLarge,
-    ServeError,
-)
-from dgraph_tpu.serve.health import serve_health_record
+from __future__ import annotations
 
 __all__ = [
     "BucketLadder",
     "EngineStopped",
     "MicroBatcher",
+    "ModelRegistry",
     "QueueFull",
+    "QuotaExceeded",
     "RequestTimeout",
     "RequestTooLarge",
     "ServeEngine",
     "ServeError",
+    "SwapRejected",
+    "TenantDegraded",
+    "TenantQuota",
+    "TenantTable",
     "pad_ids",
     "serve_health_record",
 ]
+
+_LAZY = {
+    "BucketLadder": ("dgraph_tpu.serve.bucketing", "BucketLadder"),
+    "EngineStopped": ("dgraph_tpu.serve.errors", "EngineStopped"),
+    "MicroBatcher": ("dgraph_tpu.serve.batcher", "MicroBatcher"),
+    "ModelRegistry": ("dgraph_tpu.serve.registry", "ModelRegistry"),
+    "QueueFull": ("dgraph_tpu.serve.errors", "QueueFull"),
+    "QuotaExceeded": ("dgraph_tpu.serve.errors", "QuotaExceeded"),
+    "RequestTimeout": ("dgraph_tpu.serve.errors", "RequestTimeout"),
+    "RequestTooLarge": ("dgraph_tpu.serve.errors", "RequestTooLarge"),
+    "ServeEngine": ("dgraph_tpu.serve.engine", "ServeEngine"),
+    "ServeError": ("dgraph_tpu.serve.errors", "ServeError"),
+    "SwapRejected": ("dgraph_tpu.serve.errors", "SwapRejected"),
+    "TenantDegraded": ("dgraph_tpu.serve.errors", "TenantDegraded"),
+    "TenantQuota": ("dgraph_tpu.serve.tenancy", "TenantQuota"),
+    "TenantTable": ("dgraph_tpu.serve.tenancy", "TenantTable"),
+    "pad_ids": ("dgraph_tpu.serve.bucketing", "pad_ids"),
+    "serve_health_record": ("dgraph_tpu.serve.health", "serve_health_record"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value  # cache: pay the import once
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -13,7 +13,15 @@ through the micro-batcher -> hard assertions:
 - bucketed served logits == the full eval forward's logits **bit-for-bit**
   (same params, same plan, same ``model_apply`` body);
 - an over-ladder request is rejected with the structured ``too_large``
-  error.
+  error;
+- **hot-swap rollover** (control plane, compile-free): a second
+  checkpoint step swaps in under the same warmed executables — zero new
+  compiles, served==eval parity under the NEW params — and a
+  chaos-injected fault mid-swap rolls back to the adopted params with the
+  structured ``swap_rejected`` error;
+- **tenant quotas**: a flooding tenant is shed with the structured
+  ``quota`` rejection while a second tenant on the same batcher keeps
+  being served.
 
 Exit code 0 only if every assertion holds.
 """
@@ -64,9 +72,11 @@ class Config:
     log_path: str = "logs/serve.jsonl"
 
 
-def build_serving(cfg: Config):
+def build_serving(cfg: Config, tenants=None):
     """Graph -> params (checkpoint round trip if configured) -> warmed
-    engine + batcher. Shared by this CLI and experiments/serve_bench.py."""
+    engine + batcher. Shared by this CLI and experiments/serve_bench.py
+    (which passes its ``TenantTable`` as ``tenants`` for the multi-tenant
+    open-loop mode)."""
     import jax
     import numpy as np
 
@@ -169,8 +179,112 @@ def build_serving(cfg: Config):
         max_queue_depth=cfg.max_queue_depth,
         default_timeout_s=cfg.request_timeout_s,
         registry=registry,
+        tenants=tenants,
     )
     return engine, batcher, g
+
+
+def _selftest_swap(cfg: Config, engine, log) -> list:
+    """Hot-swap rollover under the warmed executables: adopt a perturbed
+    step-1 checkpoint (zero compiles, parity pinned), then prove the
+    chaos-injected mid-swap fault rolls back to the adopted params."""
+    import numpy as np
+
+    from dgraph_tpu import chaos
+    from dgraph_tpu.serve.errors import SwapRejected
+    from dgraph_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
+
+    failures = []
+    state = restore_checkpoint(cfg.ckpt_dir)
+    scaled = _scale_float_leaves(state["params"], 1.0625)
+    save_checkpoint(cfg.ckpt_dir, {"params": scaled, "step": 1}, 1)
+    rec = engine.swap_params(cfg.ckpt_dir, step=1)
+    log.write(rec)
+    if not rec.get("adopted"):
+        failures.append(f"hot swap not adopted: {rec}")
+    if engine.recompiles_since_warmup() != 0:
+        failures.append("hot swap minted XLA compiles")
+    full = engine.full_logits()
+    ids = np.arange(min(engine.ladder.sizes[0], engine.num_nodes))
+    out = engine.infer(ids)
+    r, s = engine.rank_slot(ids)
+    if not np.array_equal(out, full[r, s]):
+        failures.append("post-swap served logits diverge from eval forward")
+    # fault mid-swap: rollback to the adopted (step-1) params, serving
+    # uninterrupted — the bits prove nothing moved
+    chaos.arm("serve.swap=raise@0")
+    try:
+        engine.swap_params(cfg.ckpt_dir, step=0)
+        failures.append("chaos-injected swap was adopted, not rolled back")
+    except SwapRejected as e:
+        log.write(e.record())
+        if not e.context.get("rolled_back"):
+            failures.append("chaos-injected swap rejection not rolled back")
+    finally:
+        chaos.reset()
+    if not np.array_equal(engine.infer(ids), full[r, s]):
+        failures.append("rollback disturbed the serving params")
+    if engine.recompiles_since_warmup() != 0:
+        failures.append("swap rollback minted XLA compiles")
+    return failures
+
+
+def _scale_float_leaves(tree, factor: float):
+    import numpy as np
+
+    if isinstance(tree, dict):
+        return {k: _scale_float_leaves(v, factor) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_scale_float_leaves(v, factor) for v in tree)
+    arr = np.asarray(tree)
+    # exact power-of-two-ish factor keeps the perturbation bit-stable
+    return arr * np.asarray(factor, arr.dtype) if arr.dtype.kind == "f" else arr
+
+
+def _selftest_quota(engine, log) -> list:
+    """Per-tenant quotas on a second batcher over the SAME warmed engine
+    (compile-free): the flooding tenant is shed with the structured
+    ``quota`` error, the calm tenant keeps being served."""
+    import numpy as np
+
+    from dgraph_tpu.serve.batcher import MicroBatcher
+    from dgraph_tpu.serve.errors import QuotaExceeded
+    from dgraph_tpu.serve.tenancy import TenantQuota, TenantTable
+
+    failures = []
+    table = TenantTable(
+        TenantQuota(rps=0.0, burst=8, max_queue_share=1.0),
+        quotas={"flood": TenantQuota(rps=0.001, burst=2, max_queue_share=0.25)},
+    )
+    from dgraph_tpu.obs.metrics import Metrics
+
+    # own metrics registry: the main selftest pins the traffic loop's
+    # request count, and the quota probe must not inflate it
+    bat = MicroBatcher(
+        engine, max_batch_size=4, max_delay_ms=0.5, max_queue_depth=16,
+        tenants=table, registry=Metrics(),
+    )
+    try:
+        shed = 0
+        for _ in range(6):  # burst of 2, then the bucket is dry
+            try:
+                bat.infer(np.arange(4), tenant="flood")
+            except QuotaExceeded as e:
+                shed += 1
+                log.write(e.record())
+        if shed != 4:
+            failures.append(f"flood tenant shed {shed}/4 over-quota requests")
+        out = bat.infer(np.arange(4), tenant="calm")
+        if out.shape[0] != 4:
+            failures.append("calm tenant was not served during the flood")
+        snap = table.snapshot()
+        if snap["flood"]["shed_quota"] != 4 or snap["calm"]["shed_quota"] != 0:
+            failures.append(f"tenant shed accounting wrong: {snap}")
+    finally:
+        bat.stop()
+    if engine.recompiles_since_warmup() != 0:
+        failures.append("quota path minted XLA compiles")
+    return failures
 
 
 def main(cfg: Config) -> dict:
@@ -227,6 +341,8 @@ def main(cfg: Config) -> dict:
                 failures.append("over-ladder request was not rejected")
             except RequestTooLarge as e:
                 log.write(e.record())
+            failures += _selftest_swap(cfg, engine, log)
+            failures += _selftest_quota(engine, log)
 
         rec = serve_health_record(engine, batcher)
         if failures:

@@ -45,6 +45,7 @@ from dgraph_tpu.serve.errors import (
     RequestTooLarge,
     WorkerCrashed,
 )
+from dgraph_tpu.serve.tenancy import TenantTable
 
 
 @dataclasses.dataclass
@@ -60,6 +61,9 @@ class _Pending:
     # lifecycle, so the trace id survives every rejection path.
     span: object = spans.NOOP_SPAN
     popped_at: float = 0.0  # when the worker pulled it off the queue
+    # tenant id this request was admitted under (None = no tenant table
+    # configured); every resolution path pairs the admit with one release
+    tenant: Optional[str] = None
 
 
 class MicroBatcher:
@@ -76,15 +80,25 @@ class MicroBatcher:
         max_queue_depth: int = 64,
         default_timeout_s: float = 30.0,
         registry: Optional[Metrics] = None,
+        tenants: Optional[TenantTable] = None,
     ):
         if max_batch_size < 1 or max_queue_depth < 1:
             raise ValueError("max_batch_size and max_queue_depth must be >= 1")
-        self.engine = engine
+        # `engine` may be a bare ServeEngine OR a ModelRegistry
+        # (dgraph_tpu.serve.registry): with a registry the ACTIVE engine is
+        # resolved per batch, which is what makes checkpoint/graph
+        # adoption an atomic between-batches flip with zero dropped
+        # requests
+        self._source = engine
+        # per-tenant admission (token-bucket quotas, queue shares,
+        # per-tenant degraded shedding); None = single-tenant behavior,
+        # byte-for-byte the pre-tenancy semantics
+        self.tenants = tenants
         self.max_batch_size = int(max_batch_size)
         self.max_delay_ms = float(max_delay_ms)
         self.max_queue_depth = int(max_queue_depth)
         self.default_timeout_s = float(default_timeout_s)
-        self.registry = registry if registry is not None else engine.registry
+        self.registry = registry if registry is not None else self.engine.registry
         self._q: collections.deque = collections.deque()
         self._cv = threading.Condition()
         self._stopped = False
@@ -103,6 +117,14 @@ class MicroBatcher:
 
         atexit.register(self.stop)
 
+    @property
+    def engine(self):
+        """The engine the next operation should run on — the bare engine,
+        or the registry's ACTIVE entry (read per call, so a control-plane
+        ``activate`` flips new batches to a new engine atomically)."""
+        src = self._source
+        return src.active_engine if hasattr(src, "active_engine") else src
+
     def __len__(self) -> int:
         """Current queue depth (requests waiting, not in flight)."""
         with self._cv:
@@ -110,35 +132,52 @@ class MicroBatcher:
 
     # --- client side ---
 
-    def submit(self, node_ids, timeout_s: Optional[float] = None) -> Future:
+    def submit(self, node_ids, timeout_s: Optional[float] = None,
+               *, tenant: Optional[str] = None) -> Future:
         """Enqueue one request; returns a Future of the [n, C] logits.
 
         Raises (never queues past) :class:`QueueFull` at capacity,
-        :class:`RequestTooLarge` for requests no bucket fits, and
-        :class:`EngineStopped` after :meth:`stop`.
+        :class:`RequestTooLarge` for requests no bucket fits,
+        :class:`EngineStopped` after :meth:`stop`, and — with a
+        :class:`~dgraph_tpu.serve.tenancy.TenantTable` configured — the
+        structured per-tenant rejections (:class:`~dgraph_tpu.serve.
+        errors.QuotaExceeded` / :class:`~dgraph_tpu.serve.errors.
+        TenantDegraded`) for ``tenant``'s own overage, leaving every other
+        tenant's admission untouched.
         """
+        from dgraph_tpu.serve.tenancy import DEFAULT_TENANT
+
         ids = np.asarray(node_ids)
         if ids.ndim != 1:
             raise ValueError(f"node_ids must be 1-D, got shape {ids.shape}")
+        # ONE tenant-id resolution shared by every accounting path below
+        # (admit, failure attribution): '' and None must not land in
+        # different tenant buckets
+        tenant_id = DEFAULT_TENANT if tenant is None else str(tenant)
         # the per-request span opens at submit (client thread) and follows
         # the request across the worker thread; rejection paths end it
         # with the structured error code, so the trace id survives
         # QueueFull/too-large/stopped exactly like a served request
-        req_span = spans.span("serve.request", n=int(ids.shape[0]))
+        req_span = spans.span("serve.request", n=int(ids.shape[0]),
+                              tenant=tenant_id if self.tenants else tenant)
         # full request validation up front: an impossible request must not
         # occupy a queue slot, and — because the worker CONCATENATES
         # requests — must never reach the engine, where its failure would
-        # fan out to every innocent request coalesced into the same batch
+        # fan out to every innocent request coalesced into the same batch.
+        # A malformed request is also a TENANT signal: poisoned payloads
+        # count toward that tenant's (and only that tenant's) degrading.
         try:
             self.engine.ladder.bucket_for(ids.shape[0])
         except RequestTooLarge:
             self.registry.counter("serve.rejected_too_large")
+            self._note_tenant_failure(tenant_id)
             req_span.end(error="too_large")
             raise
         num_nodes = getattr(self.engine, "num_nodes", None)
         if num_nodes is not None and ids.size and (
             ids.min() < 0 or ids.max() >= num_nodes
         ):
+            self._note_tenant_failure(tenant_id)
             req_span.end(error="bad_ids")
             raise ValueError(
                 f"node ids must be in [0, {num_nodes}), got "
@@ -159,17 +198,56 @@ class MicroBatcher:
                     queue_depth=len(self._q),
                     max_queue_depth=self.max_queue_depth,
                 )
+            admitted_tenant = None
+            if self.tenants is not None:
+                # per-tenant admission (rate bucket, queue share,
+                # degraded shedding) — raises the structured rejection;
+                # success charges a queue slot that every resolution
+                # path below releases exactly once
+                try:
+                    admitted_tenant = self.tenants.admit(
+                        tenant_id, self.max_queue_depth
+                    )
+                except Exception as e:
+                    code = getattr(e, "code", "quota")
+                    self.registry.counter(f"serve.rejected_{code}")
+                    req_span.end(error=code)
+                    raise
             fut: Future = Future()
             self._q.append(
-                _Pending(ids, fut, now, now + timeout_s, span=req_span)
+                _Pending(ids, fut, now, now + timeout_s, span=req_span,
+                         tenant=admitted_tenant)
             )
             self.registry.gauge("serve.queue_depth", float(len(self._q)))
             self._cv.notify()
         return fut
 
-    def infer(self, node_ids, timeout_s: Optional[float] = None) -> np.ndarray:
+    def infer(self, node_ids, timeout_s: Optional[float] = None,
+              *, tenant: Optional[str] = None) -> np.ndarray:
         """Blocking submit: logits [n, C], or raises the structured error."""
-        return self.submit(node_ids, timeout_s).result()
+        return self.submit(node_ids, timeout_s, tenant=tenant).result()
+
+    def _note_tenant_failure(self, tenant_id: str) -> None:
+        """One request-level failure attributed to ``tenant_id`` (and the
+        shared degraded counter when that failure tips the tenant over) —
+        the ONE place both the submit-validation and worker paths report
+        through, so the two cannot count differently."""
+        if self.tenants is not None and self.tenants.observe_failure(
+            tenant_id
+        ):
+            self.registry.counter("serve.tenant_degraded")
+
+    def _release_tenant(self, p: _Pending, success: Optional[bool] = None
+                        ) -> None:
+        """Pair one admitted request with its queue-slot release (+ the
+        success/failure signal feeding per-tenant degrading)."""
+        if self.tenants is None or p.tenant is None:
+            return
+        self.tenants.release(p.tenant)
+        if success is True:
+            self.tenants.observe_success(p.tenant)
+        elif success is False:
+            self._note_tenant_failure(p.tenant)
 
     @staticmethod
     def _fail_future(fut: Future, err: Exception) -> None:
@@ -206,11 +284,13 @@ class MicroBatcher:
                     p.future, EngineStopped("batcher stopped mid-flight")
                 )
                 p.span.end(error="stopped mid-flight")
+                self._release_tenant(p)
         with self._cv:
             while self._q:
                 p = self._q.popleft()
                 self._fail_future(p.future, EngineStopped("batcher stopped"))
                 p.span.end(error="stopped")
+                self._release_tenant(p)
 
     # --- worker side ---
 
@@ -244,6 +324,7 @@ class MicroBatcher:
         for p in pending:
             self._fail_future(p.future, err)
             p.span.end(error="worker_crashed")
+            self._release_tenant(p)
         # best-effort observability: the registry itself may be what crashed
         try:
             self.registry.counter("serve.worker_crashed")
@@ -288,8 +369,33 @@ class MicroBatcher:
             self.registry.gauge("serve.queue_depth", float(len(self._q)))
             return batch
 
+    def _revalidate(self, eng, p: _Pending):
+        """Re-check one queued request against the engine that will ACTUALLY
+        run it. Submit-time validation ran against whatever engine was
+        active then; a registry flip (rollback to a smaller graph, a
+        replacement ladder) between submit and flush would otherwise let a
+        stale request reach the engine, where its failure fans out to every
+        innocent request coalesced into the same batch. Returns the
+        structured error to fail JUST this request with, or None."""
+        try:
+            eng.ladder.bucket_for(p.ids.shape[0])
+        except RequestTooLarge as e:
+            return e
+        num_nodes = getattr(eng, "num_nodes", None)
+        if num_nodes is not None and p.ids.size and (
+            p.ids.min() < 0 or p.ids.max() >= num_nodes
+        ):
+            return ValueError(
+                f"node ids must be in [0, {num_nodes}) on the engine now "
+                f"active, got [{p.ids.min()}, {p.ids.max()}]"
+            )
+        return None
+
     def _flush(self, batch) -> None:
         now = time.monotonic()
+        # resolve the active engine ONCE per flush: a registry activate()
+        # landing mid-flush must not split one batch across two engines
+        eng = self.engine
         live = []
         for p in batch:
             # a client-cancelled future is dropped exactly like an expired
@@ -302,6 +408,7 @@ class MicroBatcher:
             if not p.future.set_running_or_notify_cancel():
                 self.registry.counter("serve.rejected_cancelled")
                 p.span.end(error="cancelled")
+                self._release_tenant(p)
                 continue
             if now > p.deadline:
                 self.registry.counter("serve.rejected_timeout")
@@ -315,8 +422,16 @@ class MicroBatcher:
                 )
                 p.span.end(error="timeout",
                            queue_wait_ms=round((now - p.enqueued_at) * 1e3, 3))
-            else:
-                live.append(p)
+                self._release_tenant(p)
+                continue
+            stale_err = self._revalidate(eng, p)
+            if stale_err is not None:
+                self.registry.counter("serve.rejected_stale")
+                p.future.set_exception(stale_err)
+                p.span.end(error=getattr(stale_err, "code", "bad_ids"))
+                self._release_tenant(p)
+                continue
+            live.append(p)
         if not live:
             return  # expired/cancelled-only batch: flush empty, no engine call
         # per-request stage times: queue_wait (enqueue -> worker pop) and
@@ -330,20 +445,49 @@ class MicroBatcher:
             self.registry.histogram(
                 "serve.stage.batch_form_ms", max(now - popped, 0.0) * 1e3
             )
+        # re-chunk against the RESOLVED engine's largest bucket: _collect
+        # split against the engine active at pop time, and a flip to a
+        # shorter (entry-replacing register) ladder between pop and flush
+        # would otherwise overflow the bucket for the whole batch
+        cap = eng.ladder.max_size
+        chunk, total = [], 0
+        for p in live:
+            n = int(p.ids.shape[0])
+            if chunk and total + n > cap:
+                self._dispatch(eng, chunk, now)
+                chunk, total = [], 0
+            chunk.append(p)
+            total += n
+        self._dispatch(eng, chunk, now)
+
+    def _dispatch(self, eng, live, now: float) -> None:
         ids = np.concatenate([p.ids for p in live]) if len(live) > 1 else live[0].ids
         try:
             # the batch span is the worker thread's ambient span, so the
             # engine's serve.infer span parents under it
             with spans.span("serve.batch", requests=len(live),
                             n=int(ids.shape[0])):
-                out = self.engine.infer(ids)
+                out = eng.infer(ids)
         except Exception as e:  # noqa: BLE001 — fan the failure to every waiter
             err_label = f"{type(e).__name__}: {e}"
+            # engine-level STRUCTURED rejections (backpressure, degraded
+            # shed) are the ENGINE's state, not any tenant's payload —
+            # booking them as tenant failures would let a backend outage
+            # degrade every innocent tenant. Only raw engine exceptions
+            # feed the per-tenant consecutive-failure streak (where
+            # collateral hits from a co-batched poisoner wash out while
+            # the poisoner's own streak accumulates).
+            from dgraph_tpu.serve.errors import ServeError
+
+            tenant_fault = not isinstance(e, ServeError)
             for p in live:
                 p.future.set_exception(e)
                 p.span.end(error=err_label[:200])
+                self._release_tenant(
+                    p, success=False if tenant_fault else None
+                )
             return
-        stage = getattr(self.engine, "last_stage_ms", {})
+        stage = getattr(eng, "last_stage_ms", {})
         off = 0
         reply_t0 = time.monotonic()
         for p in live:
@@ -358,6 +502,14 @@ class MicroBatcher:
             self.registry.histogram(
                 "serve.request_ms", (done - p.enqueued_at) * 1e3
             )
+            if p.tenant is not None:
+                # per-tenant end-to-end latency: the p99-under-contention
+                # artifact serve_bench's multi-tenant mode reports
+                self.registry.histogram(
+                    f"serve.tenant.{p.tenant}.request_ms",
+                    (done - p.enqueued_at) * 1e3,
+                )
+            self._release_tenant(p, success=True)
             p.span.end(
                 queue_wait_ms=round((popped - p.enqueued_at) * 1e3, 3),
                 batch_form_ms=round(max(now - popped, 0.0) * 1e3, 3),

@@ -1,0 +1,501 @@
+"""Live graph deltas: pad-slot appends, background re-plan, atomic adoption.
+
+A serving graph is not static — new vertices and edges arrive while the
+fleet is under traffic, and "rebuild everything and restart" drops
+requests and re-pays the warmup. This module splits graph growth into a
+fast live half and a durable background half, glued by the same
+generation-pointer discipline the shrink-to-fit recovery proved
+(:mod:`dgraph_tpu.train.shrink`):
+
+- **Append (live, bounded).** Every plan already pads each rank's vertex
+  block to ``n_pad``; the slack above the real count is *reserved
+  capacity*. :func:`append_delta` makes the new vertices/edges durable
+  (one atomic npz per append, staged against the current generation), and
+  :meth:`~dgraph_tpu.serve.engine.ServeEngine.append_vertices` installs
+  the vertices into those pad slots on the running engine — queryable
+  immediately, zero shape changes, zero recompiles. New *edges* stay
+  staged (the plan's routing is static) until the next adoption: an
+  appended vertex serves as an isolated vertex until then.
+
+- **Re-plan (background, resumable).** :func:`replan` composes the base
+  graph with every staged delta, re-partitions the new vertices with the
+  SAME deterministic waterfill the live append used (placement is
+  preserved, so adoption moves no already-served vertex), and rebuilds
+  the sharded plan artifact for generation ``g+1`` through the streaming
+  :func:`~dgraph_tpu.plan.build_plan_shards` (memory-budgeted, durable
+  per shard, resumable after a kill).
+
+- **Adopt (atomic).** Only after the new generation's plan and graph
+  snapshot are fully durable does the ``serving.json`` pointer flip — one
+  atomic rename (:func:`~dgraph_tpu.plan_shards.atomic_write_json`). A
+  crash ANYWHERE leaves the old or the new generation adopted, never a
+  torn mix (chaos-pinned via ``serve.replan=sigterm``). The serving
+  process then builds a fresh engine for the generation
+  (:func:`build_engine`), warms it off-path, and flips it live through
+  :meth:`~dgraph_tpu.serve.registry.ModelRegistry.activate` — in-flight
+  batches finish on the old engine, the next batch runs on the new one.
+
+Layout under one run directory::
+
+    run_dir/
+      serving.json          <- THE adoption pointer {generation, ...}
+      graph_g0.npz          <- original-numbering edges+features+partition
+      plan_g0/              <- v8 sharded plan artifact (manifest+shards)
+      deltas_g0/            <- staged appends AGAINST generation 0
+        delta_0000.npz
+      graph_g1.npz  plan_g1/  deltas_g1/   <- next generation, same shape
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+SERVE_POINTER = "serving.json"
+
+# per-run_dir append/adopt serialization for THIS process (the owning
+# serving process runs appends on request threads and replan in a
+# background thread); cross-process append collisions are additionally
+# closed by the no-clobber link publish in append_delta
+_RUN_LOCKS: dict = {}
+_RUN_LOCKS_GUARD = threading.Lock()
+
+
+def _run_lock(run_dir: str) -> threading.Lock:
+    key = os.path.abspath(run_dir)
+    with _RUN_LOCKS_GUARD:
+        lock = _RUN_LOCKS.get(key)
+        if lock is None:
+            lock = _RUN_LOCKS[key] = threading.Lock()
+        return lock
+
+
+class DeltaError(RuntimeError):
+    """A delta append or generation transition could not complete."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"serve graph-delta failure: {reason}")
+        self.reason = reason
+
+    def record(self) -> dict:
+        return {"kind": "serve_delta_error", "reason": self.reason}
+
+
+# ---------------------------------------------------------------------------
+# generation layout (ONE place derives every path — the shrink.py discipline)
+# ---------------------------------------------------------------------------
+
+
+def world_path(run_dir: str) -> str:
+    return os.path.join(run_dir, SERVE_POINTER)
+
+
+def plan_dir(run_dir: str, generation: int) -> str:
+    return os.path.join(run_dir, f"plan_g{generation}")
+
+
+def graph_path(run_dir: str, generation: int) -> str:
+    return os.path.join(run_dir, f"graph_g{generation}.npz")
+
+
+def delta_dir(run_dir: str, generation: int) -> str:
+    return os.path.join(run_dir, f"deltas_g{generation}")
+
+
+def read_world(run_dir: str) -> dict:
+    """The current adoption pointer; raises :class:`DeltaError` when the
+    run directory holds none (the atomic write makes a torn pointer real
+    corruption, not a benign race)."""
+    path = world_path(run_dir)
+    try:
+        with open(path) as fh:
+            rec = json.load(fh)
+    except OSError as e:
+        raise DeltaError(f"no serving pointer at {path} ({e})")
+    except ValueError as e:
+        raise DeltaError(f"serving pointer {path} unreadable: {e}")
+    if rec.get("kind") != "serve_world":
+        raise DeltaError(f"{path} is not a serve_world record")
+    return rec
+
+
+def write_world(run_dir: str, rec: dict) -> None:
+    """ATOMIC adoption: the rename is the commit point of a generation
+    transition."""
+    from dgraph_tpu.plan_shards import atomic_write_json
+
+    atomic_write_json(world_path(run_dir), rec)
+
+
+def _atomic_savez(path: str, **arrays) -> None:
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# deterministic new-vertex placement (shared with ServeEngine.append_vertices)
+# ---------------------------------------------------------------------------
+
+
+def assign_new_vertices(fill: np.ndarray, k: int) -> np.ndarray:
+    """Rank assignment for ``k`` appended vertices over per-rank occupancy
+    ``fill`` (mutated in place): least-filled rank first, lowest rank id
+    on ties. Deterministic on purpose — the live append and the
+    background re-plan replay the SAME placement, so adoption never moves
+    a vertex that is already being served from its pad slot's rank."""
+    fill = np.asarray(fill)
+    ranks = np.empty(k, np.int32)
+    for i in range(k):
+        r = int(np.argmin(fill))
+        ranks[i] = r
+        fill[r] += 1
+    return ranks
+
+
+# ---------------------------------------------------------------------------
+# world lifecycle
+# ---------------------------------------------------------------------------
+
+
+def init_world(
+    run_dir: str,
+    edge_index: np.ndarray,
+    features: np.ndarray,
+    *,
+    world_size: int,
+    partition_method: str = "random",
+    seed: int = 0,
+    pad_multiple: int = 8,
+    memory_budget_bytes: Optional[int] = None,
+) -> dict:
+    """Create generation 0 of a delta-capable serving world: partition the
+    graph, build the sharded plan artifact, snapshot the graph in its
+    ORIGINAL numbering, adopt ``serving.json``. Idempotent on rerun (the
+    plan build resumes; the pointer write is last)."""
+    from dgraph_tpu.partition import partition_graph
+    from dgraph_tpu.plan import build_plan_shards
+
+    os.makedirs(run_dir, exist_ok=True)
+    edge_index = np.asarray(edge_index)
+    features = np.asarray(features, np.float32)
+    num_nodes = int(features.shape[0])
+    new_edges, ren = partition_graph(
+        edge_index, num_nodes, world_size, method=partition_method,
+        seed=seed,
+    )
+    part_orig = np.asarray(ren.partition)[np.asarray(ren.perm)]
+    _atomic_savez(
+        graph_path(run_dir, 0),
+        edge_index=edge_index,  # ORIGINAL numbering: deltas append to it
+        features=features,
+        partition=part_orig,
+    )
+    build_plan_shards(
+        new_edges, ren.partition, out_dir=plan_dir(run_dir, 0),
+        world_size=world_size, pad_multiple=pad_multiple,
+        write_layout=True, memory_budget_bytes=memory_budget_bytes,
+    )
+    rec = {
+        "kind": "serve_world",
+        "generation": 0,
+        "world_size": int(world_size),
+        "num_nodes": num_nodes,
+        "num_edges": int(edge_index.shape[1]),
+        "feat_dim": int(features.shape[1]),
+        "pad_multiple": int(pad_multiple),
+        "partition_method": partition_method,
+        "seed": int(seed),
+        "deltas_adopted": 0,
+    }
+    write_world(run_dir, rec)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# staged deltas
+# ---------------------------------------------------------------------------
+
+
+def staged_delta_paths(run_dir: str, generation: int) -> list:
+    d = delta_dir(run_dir, generation)
+    if not os.path.isdir(d):
+        return []
+    return sorted(
+        os.path.join(d, f) for f in os.listdir(d)
+        if f.startswith("delta_") and f.endswith(".npz")
+    )
+
+
+def append_delta(run_dir: str, features, edge_index) -> dict:
+    """Durably stage new vertices (+ their edges, which may reference any
+    existing or just-appended vertex) against the current generation.
+    Returns the structured record, including ``id_base`` — the original
+    ids of the appended vertices are ``id_base .. id_base+k``.
+
+    Durability order matters: stage here FIRST, then install live with
+    ``engine.append_vertices`` — a crash between the two replays the
+    append from disk at the next re-plan instead of losing it. The
+    ``serve.delta_append`` chaos point fires at entry."""
+    from dgraph_tpu import chaos
+
+    chaos.fire("serve.delta_append")
+    feats = np.asarray(features, np.float32)
+    edges = np.asarray(edge_index, np.int64)
+    if edges.size and (edges.ndim != 2 or edges.shape[0] != 2):
+        raise DeltaError(f"delta edge_index must be [2, m], got {edges.shape}")
+    edges = edges.reshape(2, -1)
+    k = int(feats.shape[0])
+    with _run_lock(run_dir):
+        # under the lock: the pointer read, the seq/id_base derivation,
+        # and the publish are one atomic step against this process's
+        # other appenders AND against replan's commit (which re-snapshots
+        # under the same lock before flipping the pointer)
+        world = read_world(run_dir)
+        gen = int(world["generation"])
+        if feats.ndim != 2 or feats.shape[1] != int(world["feat_dim"]):
+            raise DeltaError(
+                f"delta features must be [k, {world['feat_dim']}], got "
+                f"{feats.shape}"
+            )
+        os.makedirs(delta_dir(run_dir, gen), exist_ok=True)
+        while True:
+            existing = staged_delta_paths(run_dir, gen)
+            if existing:
+                # O(1) per append: every delta file stamps its own
+                # id_base + new_nodes scalars, so the NEXT base reads one
+                # file's scalars instead of decompressing every staged
+                # features array
+                last = np.load(existing[-1])
+                id_base = int(last["id_base"]) + int(last["new_nodes"])
+            else:
+                id_base = int(world["num_nodes"])
+            if edges.size and (
+                edges.min() < 0 or edges.max() >= id_base + k
+            ):
+                raise DeltaError(
+                    f"delta edges reference vertex ids outside "
+                    f"[0, {id_base + k})"
+                )
+            seq = len(existing)
+            path = os.path.join(
+                delta_dir(run_dir, gen), f"delta_{seq:04d}.npz"
+            )
+            tmp = path + ".tmp.npz"
+            np.savez(
+                tmp, features=feats, edge_index=edges,
+                id_base=np.int64(id_base), new_nodes=np.int64(k),
+            )
+            try:
+                # no-clobber publish: link() fails (instead of silently
+                # overwriting like os.replace) if ANOTHER process raced
+                # this seq — on collision, recompute seq/id_base and retry
+                os.link(tmp, path)
+                os.unlink(tmp)
+                break
+            except FileExistsError:
+                os.unlink(tmp)
+    return {
+        "kind": "serve_delta",
+        "generation": gen,
+        "seq": seq,
+        "new_nodes": k,
+        "new_edges": int(edges.shape[1]),
+        "id_base": id_base,
+    }
+
+
+# ---------------------------------------------------------------------------
+# background re-plan + atomic adoption
+# ---------------------------------------------------------------------------
+
+
+def replan(
+    run_dir: str, *, memory_budget_bytes: Optional[int] = None,
+    max_rounds: int = 5,
+) -> dict:
+    """Fold every staged delta into generation ``g+1`` and adopt it.
+
+    Crash-safe and rerunnable, mirroring ``shrink_world``: all artifacts
+    are written under the NEW generation's names (the old generation stays
+    intact and adopted throughout), the streaming plan build resumes from
+    its own manifest, the graph snapshot write is atomic, and the
+    ``serving.json`` flip is the single commit point. The ``serve.replan``
+    chaos point fires at entry (before any build work) and again at each
+    commit boundary after every artifact is durable but before the pointer
+    flips — so both torn windows are deterministically testable.
+
+    Append-safe: the commit re-snapshots the staged set under the same
+    lock ``append_delta`` publishes under — a delta that landed while the
+    build ran is never orphaned; the fold runs another round including it
+    (up to ``max_rounds``, then a structured :class:`DeltaError` tells the
+    operator to quiesce appends) and only a build whose input set is still
+    current adopts.
+
+    Memory note: the COMPOSITION is whole-graph-resident on the host
+    (base features/edges + staged deltas are concatenated before the
+    build); ``memory_budget_bytes`` bounds the plan build's per-shard
+    peak, not this composition step.
+
+    With nothing staged this is a no-op returning the current pointer.
+    """
+    from dgraph_tpu import chaos
+    from dgraph_tpu.obs import spans
+    from dgraph_tpu.partition import renumber_contiguous
+    from dgraph_tpu.plan import build_plan_shards
+
+    world = read_world(run_dir)
+    gen, W = int(world["generation"]), int(world["world_size"])
+    chaos.fire("serve.replan")
+    delta_paths = staged_delta_paths(run_dir, gen)
+    if not delta_paths:
+        return world
+    with spans.span(
+        "serve.replan", run_dir=run_dir, generation=gen + 1,
+        deltas=len(delta_paths),
+    ):
+        for _round in range(max_rounds):
+            base = np.load(graph_path(run_dir, gen))
+            part = np.asarray(base["partition"])
+            fill = np.bincount(part, minlength=W).astype(np.int64)
+            feats = [np.asarray(base["features"])]
+            edges = [np.asarray(base["edge_index"])]
+            parts = [part]
+            for p in delta_paths:
+                d = np.load(p)
+                k = int(d["features"].shape[0])
+                # the SAME waterfill the live append ran
+                # (assign_new_vertices mutates fill), so placement
+                # composes identically
+                parts.append(assign_new_vertices(fill, k))
+                feats.append(np.asarray(d["features"]))
+                edges.append(np.asarray(d["edge_index"]))
+            partition_full = np.concatenate(parts)
+            features_full = np.concatenate(feats)
+            edges_full = np.concatenate(edges, axis=1)
+            V_new = int(partition_full.shape[0])
+            ren = renumber_contiguous(partition_full, W)
+            new_edges = np.asarray(ren.perm)[edges_full]
+            build_plan_shards(
+                new_edges, ren.partition,
+                out_dir=plan_dir(run_dir, gen + 1),
+                world_size=W, pad_multiple=int(world.get("pad_multiple", 8)),
+                write_layout=True, memory_budget_bytes=memory_budget_bytes,
+            )
+            _atomic_savez(
+                graph_path(run_dir, gen + 1),
+                edge_index=edges_full,
+                features=features_full,
+                partition=partition_full,
+            )
+            # every artifact is durable; the pointer flip below is the
+            # commit — a sigterm injected HERE must leave generation g
+            # adopted
+            chaos.fire("serve.replan")
+            with _run_lock(run_dir):
+                latest = staged_delta_paths(run_dir, gen)
+                if latest == delta_paths:
+                    rec = {
+                        **world,
+                        "generation": gen + 1,
+                        "num_nodes": V_new,
+                        "num_edges": int(edges_full.shape[1]),
+                        "deltas_adopted": int(world.get("deltas_adopted", 0))
+                        + len(delta_paths),
+                    }
+                    write_world(run_dir, rec)
+                    return rec
+            # a delta landed mid-build: adopting now would orphan it (the
+            # next generation only ever reads its OWN staged dir) — fold
+            # again with the grown set
+            delta_paths = latest
+        raise DeltaError(
+            f"staged deltas kept arriving across {max_rounds} replan "
+            "rounds; quiesce appends (or raise max_rounds) to adopt"
+        )
+
+
+# ---------------------------------------------------------------------------
+# loading an adopted generation into a serving engine
+# ---------------------------------------------------------------------------
+
+
+def load_generation(run_dir: str, *, verify: bool = True) -> dict:
+    """Everything a :class:`~dgraph_tpu.serve.engine.ServeEngine` needs
+    for the currently adopted generation: assembled plan + layout (from
+    the v8 shard artifact), vertex-sharded batch, original-id -> (rank,
+    slot) maps."""
+    from dgraph_tpu.partition import renumber_contiguous
+    from dgraph_tpu.plan import load_sharded_plan, shard_vertex_data
+
+    world = read_world(run_dir)
+    gen, W = int(world["generation"]), int(world["world_size"])
+    plan, layout = load_sharded_plan(plan_dir(run_dir, gen), verify=verify)
+    graph = np.load(graph_path(run_dir, gen))
+    part = np.asarray(graph["partition"])
+    V = int(part.shape[0])
+    ren = renumber_contiguous(part, W)
+    n_pad = int(plan.n_src_pad)
+    feats = shard_vertex_data(
+        np.asarray(graph["features"])[ren.inv], ren.counts, n_pad
+    ).astype(np.float32)
+    vmask = shard_vertex_data(np.ones(V, np.float32), ren.counts, n_pad)
+    id_rank = np.asarray(ren.partition)[np.asarray(ren.perm)]
+    id_slot = np.asarray(ren.perm) - np.asarray(ren.offsets)[id_rank]
+    return {
+        "world": world,
+        "generation": gen,
+        "plan": plan,
+        "layout": layout,
+        "edge_index": np.asarray(graph["edge_index"]),
+        "batch": {"x": feats, "vmask": vmask},
+        "id_rank": id_rank.astype(np.int32),
+        "id_slot": id_slot.astype(np.int32),
+        "num_nodes": V,
+    }
+
+
+def build_engine(
+    run_dir: str,
+    model,
+    mesh,
+    params,
+    *,
+    add_symmetric_norm: bool = False,
+    verify: bool = True,
+    **engine_kwargs,
+):
+    """A fresh (unwarmed) engine over the adopted generation — the object
+    a :class:`~dgraph_tpu.serve.registry.ModelRegistry` activates after a
+    re-plan. Params are the caller's (adoption changes the graph, not the
+    checkpoint; run :meth:`~dgraph_tpu.serve.engine.ServeEngine.
+    swap_params` separately for that)."""
+    from dgraph_tpu.data.graph import symmetric_norm_weights
+    from dgraph_tpu.plan import shard_edge_data
+    from dgraph_tpu.serve.engine import ServeEngine
+
+    info = load_generation(run_dir, verify=verify)
+    batch = dict(info["batch"])
+    if add_symmetric_norm:
+        from dgraph_tpu.partition import renumber_contiguous
+
+        graph = np.load(graph_path(run_dir, info["generation"]))
+        ren = renumber_contiguous(
+            np.asarray(graph["partition"]),
+            int(info["world"]["world_size"]),
+        )
+        new_edges = np.asarray(ren.perm)[info["edge_index"]]
+        w = symmetric_norm_weights(new_edges, info["num_nodes"])
+        batch["edge_weight"] = shard_edge_data(
+            w, info["layout"], int(info["plan"].e_pad)
+        )
+    eng = ServeEngine(
+        model, mesh, info["plan"], params, batch,
+        info["id_rank"], info["id_slot"], **engine_kwargs,
+    )
+    eng.generation = info["generation"]
+    return eng

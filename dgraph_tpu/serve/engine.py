@@ -29,6 +29,7 @@ is caught before any engine is ever warmed.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Optional
 
@@ -88,6 +89,17 @@ class ServeEngine:
         self.retry_backoff_s = float(retry_backoff_s)
         self.degraded = False
         self._consecutive_failures = 0
+        # the ENGINE lock: serializes the degraded-mode accounting (worker
+        # thread) against reset_degraded / swap_params / append_vertices
+        # (operator threads). The hot path never holds it across a device
+        # dispatch — mutable state is flipped by single reference
+        # assignments under the lock and read once per dispatch.
+        self._lock = threading.RLock()
+        # bumped by reset_degraded: a request DISPATCHED before a reset
+        # must not count toward the fresh degrade window when it fails
+        # after the reset (the resurrect-after-reset race this epoch
+        # closes; pinned by tests/test_serve_control.py)
+        self._failure_epoch = 0
         # provenance only (the ladder/plan themselves arrive already
         # built): stamped into serve_health so latency artifacts are
         # attributable to the tuning config that produced them
@@ -104,6 +116,26 @@ class ServeEngine:
         if self._id_rank.shape != self._id_slot.shape:
             raise ValueError("id_rank / id_slot length mismatch")
         self.num_nodes = int(self._id_rank.shape[0])
+        # host mirrors of the vertex-sharded batch leaves, for live delta
+        # appends into reserved pad slots (append_vertices): mutate the
+        # mirror, then flip self._batch to fresh device arrays in ONE
+        # reference assignment
+        self._host_x = np.asarray(batch["x"]) if "x" in batch else None
+        self._host_vmask = (
+            np.asarray(batch["vmask"]) if "vmask" in batch else None
+        )
+        # per-rank slot occupancy (real vertices per rank) — the free pad
+        # slots above it are the append budget until the next re-plan
+        world = next(iter(jax.tree.leaves(self._batch))).shape[0]
+        self._slot_fill = np.bincount(
+            self._id_rank, minlength=world
+        ).astype(np.int64)
+        # control-plane provenance: checkpoint lineage (swap_params
+        # appends one record per rollover attempt) and the adopted graph
+        # generation (dgraph_tpu.serve.deltas stamps it)
+        self.ckpt_dir: Optional[str] = None
+        self.lineage: list = []
+        self.generation: Optional[int] = None
         self._batch_specs = jax.tree.map(lambda _: P(GRAPH_AXIS), batch)
         self._plan_specs = plan_in_specs(self._plan)
         # one independently-jitted forward per bucket: per-bucket executables
@@ -157,7 +189,21 @@ class ServeEngine:
         if state is None:
             raise FileNotFoundError(f"no checkpoint under {ckpt_dir!r}")
         params = state["params"] if isinstance(state, dict) and "params" in state else state
-        return cls.from_distributed_graph(model, mesh, g, params, **kwargs)
+        eng = cls.from_distributed_graph(model, mesh, g, params, **kwargs)
+        # remember the lineage root: swap_params(step=...) resolves bare
+        # step numbers against this directory
+        eng.ckpt_dir = ckpt_dir
+        eng.lineage.append({
+            "kind": "serve_rollover",
+            "event": "restore",
+            "ckpt_dir": ckpt_dir,
+            "step": int(step) if step is not None else (
+                int(state["step"])
+                if isinstance(state, dict) and "step" in state else None
+            ),
+            "adopted": True,
+        })
+        return eng
 
     # --- forward construction ---
 
@@ -258,6 +304,10 @@ class ServeEngine:
         pad_ms = (time.perf_counter() - t0) * 1e3
         t_infer = time.perf_counter()
         last_err = None
+        # failure-epoch snapshot: if reset_degraded() lands while this
+        # request is in flight, its eventual failure belongs to the OLD
+        # epoch and must not count toward (or resurrect) degraded mode
+        epoch = self._failure_epoch
         for attempt in range(self.max_retries + 1):
             if attempt:
                 # index operands are rebuilt per retry: they are DONATED to
@@ -283,10 +333,18 @@ class ServeEngine:
                     self.registry.counter("serve.infer_retries")
                     time.sleep(self.retry_backoff_s)
         else:
-            self._consecutive_failures += 1
+            degraded_now = False
+            with self._lock:
+                if epoch == self._failure_epoch:
+                    self._consecutive_failures += 1
+                    if (
+                        self._consecutive_failures >= self.degrade_after
+                        and not self.degraded
+                    ):
+                        self.degraded = True
+                        degraded_now = True
             self.registry.counter("serve.infer_failures")
-            if self._consecutive_failures >= self.degrade_after:
-                self.degraded = True
+            if degraded_now:
                 self.registry.gauge("serve.degraded", 1.0)
                 print(
                     f"[serve] engine DEGRADED after "
@@ -299,7 +357,9 @@ class ServeEngine:
                 attempts=self.max_retries + 1,
             )
             raise last_err
-        self._consecutive_failures = 0
+        with self._lock:
+            if epoch == self._failure_epoch:
+                self._consecutive_failures = 0
         infer_ms = (time.perf_counter() - t_infer) * 1e3
         # per-stage timings for the batcher's request spans + health
         # quantiles (worker-thread single-writer; read right after infer)
@@ -323,10 +383,122 @@ class ServeEngine:
     def reset_degraded(self) -> None:
         """Re-admit traffic after a degraded period (the operator's — or a
         health-checker's — explicit decision: auto-undegrading would flap
-        against a still-dead backend)."""
-        self.degraded = False
-        self._consecutive_failures = 0
+        against a still-dead backend).
+
+        Atomic against the batcher worker: state flips under the engine
+        lock, and bumping the failure epoch makes any infer that was
+        DISPATCHED before this reset report its failure into the old epoch
+        — a concurrent failure can no longer resurrect degraded mode (or
+        spend the fresh degrade window) the instant after an operator
+        re-admitted traffic."""
+        with self._lock:
+            self._failure_epoch += 1
+            self.degraded = False
+            self._consecutive_failures = 0
         self.registry.gauge("serve.degraded", 0.0)
+
+    # --- control plane: hot-swap rollover + live vertex appends ---
+
+    def swap_params(self, source=None, *, step: Optional[int] = None,
+                    params=None, parity_ids=None) -> dict:
+        """Hot-swap to a newly restored checkpoint under the SAME warmed
+        executables — zero recompiles, atomic per batch, automatic
+        rollback on a bad checkpoint.
+
+        ``source`` is a checkpoint directory (``step`` picks a step;
+        default newest readable), defaulting to the engine's own
+        :attr:`ckpt_dir`; or pass an explicit ``params`` tree. The staged
+        params are validated BEFORE the live pointer moves — structure/
+        shape/dtype against the warmed executables, host-side non-finite
+        guard, and the served==eval parity oracle run *with the staged
+        tree as an argument* through the already-compiled forwards — so a
+        rejected swap (:class:`~dgraph_tpu.serve.errors.SwapRejected`)
+        leaves the prior params serving without a single dropped request.
+        See :func:`dgraph_tpu.serve.rollover.swap_params` for the full
+        state machine; every attempt lands one record in :attr:`lineage`.
+        """
+        from dgraph_tpu.serve.rollover import swap_params as _swap
+
+        return _swap(self, source, step=step, params=params,
+                     parity_ids=parity_ids)
+
+    def free_pad_slots(self) -> int:
+        """Reserved pad capacity left for live vertex appends before the
+        next re-plan must rebuild (``serve.deltas.replan``); 0 when the
+        engine has no appendable batch."""
+        if self._host_x is None:
+            return 0
+        return int((self._host_x.shape[1] - self._slot_fill).sum())
+
+    def append_vertices(self, features) -> np.ndarray:
+        """Install new vertices into reserved pad slots, live — returns
+        their (original-numbering) ids, ``num_nodes .. num_nodes+k``.
+
+        The appended vertices are queryable immediately: their features
+        enter the sharded batch, their vertex mask flips to 1.0, and the
+        id map grows — all flipped in ONE reference assignment under the
+        engine lock, so a concurrent batch sees entirely the old or
+        entirely the new graph. Shapes never change (the rows were already
+        padded), so the warmed executables replay untouched. Edges
+        incident to appended vertices are NOT live until a background
+        re-plan is adopted (:mod:`dgraph_tpu.serve.deltas`): until then an
+        appended vertex aggregates nothing — exactly an isolated vertex.
+        Raises ValueError when the pad budget is exhausted (the signal to
+        re-plan)."""
+        if self._host_x is None:
+            raise ValueError("engine batch has no 'x' leaf to append into")
+        feats = np.asarray(features, self._host_x.dtype)
+        if feats.ndim != 2 or feats.shape[1] != self._host_x.shape[2]:
+            raise ValueError(
+                f"features must be [k, {self._host_x.shape[2]}], got "
+                f"{feats.shape}"
+            )
+        k = int(feats.shape[0])
+        with self._lock:
+            n_pad = self._host_x.shape[1]
+            if k > int((n_pad - self._slot_fill).sum()):
+                raise ValueError(
+                    f"{k} new vertices exceed the {self.free_pad_slots()} "
+                    "free pad slots; adopt a re-planned generation first "
+                    "(serve.deltas.replan)"
+                )
+            from dgraph_tpu.serve.deltas import assign_new_vertices
+
+            # deterministic waterfill SHARED with serve.deltas.replan:
+            # the background rebuild replays the same placement, so
+            # adoption never moves a vertex already served from a pad slot
+            fill = self._slot_fill.copy()
+            new_rank = assign_new_vertices(fill, k)
+            new_slot = np.empty(k, np.int32)
+            running = self._slot_fill.copy()
+            for i, r in enumerate(new_rank):
+                new_slot[i] = running[r]
+                running[r] += 1
+            # place_like: the SAME placement contract the rollover staging
+            # uses (mirror multi-device shardings, keep single-device
+            # leaves uncommitted) — shared so the two paths cannot drift
+            from dgraph_tpu.serve.rollover import place_like
+
+            x2 = self._host_x.copy()
+            x2[new_rank, new_slot] = feats
+            batch2 = dict(self._batch)
+            batch2["x"] = place_like(x2, self._batch["x"])
+            if self._host_vmask is not None:
+                vm2 = self._host_vmask.copy()
+                vm2[new_rank, new_slot] = 1.0
+                batch2["vmask"] = place_like(vm2, self._batch["vmask"])
+                self._host_vmask = vm2
+            ids = np.arange(self.num_nodes, self.num_nodes + k, dtype=np.int64)
+            # the flip: one reference assignment each — infer reads
+            # self._batch / the id maps once per dispatch
+            self._host_x = x2
+            self._batch = batch2
+            self._id_rank = np.concatenate([self._id_rank, new_rank])
+            self._id_slot = np.concatenate([self._id_slot, new_slot])
+            self._slot_fill = fill
+            self.num_nodes += k
+        self.registry.counter("serve.vertices_appended", float(k))
+        return ids
 
     def rank_slot(self, node_ids) -> tuple:
         """(rank, slot) arrays for original vertex ids — the row addresses

@@ -61,6 +61,37 @@ class EngineStopped(ServeError):
     code = "stopped"
 
 
+class QuotaExceeded(ServeError):
+    """Per-tenant admission control said no: the tenant's token bucket is
+    empty (rate quota) or its share of the bounded queue is full (space
+    quota). Rejected at submit so ONE tenant's flood degrades only that
+    tenant — every other tenant's requests keep flowing through the same
+    batcher (:mod:`dgraph_tpu.serve.tenancy`)."""
+
+    code = "quota"
+
+
+class TenantDegraded(ServeError):
+    """This tenant is shed because its own recent requests kept failing
+    (poisoned payloads, systematic bad ids): the per-tenant analog of the
+    engine's global degraded mode. Other tenants are unaffected; the
+    operator re-admits with ``TenantTable.reset(tenant)``."""
+
+    code = "tenant_degraded"
+
+
+class SwapRejected(ServeError):
+    """A checkpoint hot-swap (:meth:`~dgraph_tpu.serve.engine.ServeEngine.
+    swap_params`) was refused or rolled back — structural mismatch with the
+    warmed executables, non-finite parameters, served!=eval parity failure,
+    or a fault mid-validation. The PRIOR params remain installed (the swap
+    validates against the staged tree and only flips the live pointer after
+    every oracle passes), so serving continues uninterrupted on the old
+    checkpoint."""
+
+    code = "swap_rejected"
+
+
 class WorkerCrashed(ServeError):
     """The micro-batcher's worker thread died on an unexpected exception
     (engine bug, metrics callback, collector fault). Every pending and

@@ -28,6 +28,22 @@ _LATENCY_HISTOGRAMS = ("serve.request_ms", "serve.infer_ms")
 _STAGES = ("queue_wait", "batch_form", "pad", "infer", "reply")
 
 
+def _tenant_section(batcher, snap: dict) -> Optional[dict]:
+    """Per-tenant isolation state: admission/shed/degraded counters from
+    the TenantTable plus each tenant's p50/p95/p99 end-to-end latency (the
+    noisy-neighbor artifact — tenant B's p99 staying bounded while tenant
+    A floods is the isolation SLO, tracked from this record alone)."""
+    table = getattr(batcher, "tenants", None)
+    if table is None:
+        return None
+    out = table.snapshot()
+    for tenant, rec in out.items():
+        hist = snap["histograms"].get(f"serve.tenant.{tenant}.request_ms")
+        if hist and hist.get("count"):
+            rec["latency_ms"] = hist
+    return out
+
+
 def serve_health_record(
     engine, batcher=None, *, registry: Optional[Metrics] = None
 ) -> dict:
@@ -61,6 +77,13 @@ def serve_health_record(
         # the adopted tuning record (dgraph_tpu.tune) these latency numbers
         # were produced under, or None for the hard-coded defaults
         "tuning_record": getattr(engine, "tuning_record_id", None),
+        # control-plane provenance: checkpoint-rollover lineage (every
+        # swap_params attempt, adopted or rolled back) and the adopted
+        # graph-delta generation (dgraph_tpu.serve.deltas), so a latency
+        # artifact is attributable to the exact (checkpoint, graph
+        # generation) pair that served it
+        "lineage": list(getattr(engine, "lineage", []) or []),
+        "generation": getattr(engine, "generation", None),
         "latency_ms": latency,
         # per-stage breakdown (count/mean/p50/p95/p99 each): queue-wait vs
         # batch-form vs bucket-pad vs infer vs reply
@@ -74,4 +97,12 @@ def serve_health_record(
             "max_batch_size": batcher.max_batch_size,
             "max_delay_ms": batcher.max_delay_ms,
         }
+        tenants = _tenant_section(batcher, snap)
+        if tenants is not None:
+            rec["tenants"] = tenants
+        # a registry-backed batcher: which named model is active and the
+        # full version table (dgraph_tpu.serve.registry)
+        source = getattr(batcher, "_source", None)
+        if source is not None and hasattr(source, "active_engine"):
+            rec["models"] = source.record()
     return rec

@@ -1,0 +1,276 @@
+"""Hot-swap checkpoint rollover: restore → stage → validate → adopt|rollback.
+
+A serving fleet cannot restart to pick up a new checkpoint: the bucket
+ladder's AOT warmup is seconds of XLA compiles, and a restart drops every
+queued request. Params, however, are *arguments* to the warmed executables
+— so a rollover that keeps structure/shape/dtype identical replays the
+exact same compiled programs with new weights, and "install the new
+checkpoint" reduces to one reference assignment. This module is the state
+machine around that assignment:
+
+```
+            restore_checkpoint(step|path)            jnp.asarray
+  RESTORE ────────────────────────────────► STAGED ─────────────► VALIDATE
+                                                                     │
+          structure/shape/dtype == warmed executables?  ── no ──► ROLLBACK
+          every param leaf finite (non-finite guard)?   ── no ──► ROLLBACK
+          served == eval parity, bit-for-bit,                        │
+            through the CACHED executables?             ── no ──► ROLLBACK
+          zero new jit-cache entries?                   ── no ──► ROLLBACK
+                          │ yes
+                          ▼
+                        ADOPT   (engine._params = staged, under the lock)
+```
+
+Every oracle runs with the *staged* tree passed as an argument — the live
+pointer has not moved yet — so ROLLBACK is free: the prior params were
+never unplugged, in-flight and queued requests never notice, and the
+structured :class:`~dgraph_tpu.serve.errors.SwapRejected` carries the full
+validation record. ADOPT is atomic per batch: ``infer`` reads
+``engine._params`` once per dispatch, so a batch sees entirely old or
+entirely new params, never a mix. The ``serve.swap`` chaos point fires
+between staging and validation — an injected fault there proves the
+rollback path sheds nothing (pinned by tests/test_serve_control.py and the
+serve CLI selftest).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu.serve.errors import SwapRejected
+
+
+def place_like(new, old):
+    """Device-place ``new`` to mirror ``old``'s placement: a
+    multi-device-sharded leaf is reproduced exactly (a layout change would
+    specialize a fresh executable — the recompile the swap/append paths
+    exist to avoid), while a single-device leaf stays UNCOMMITTED like the
+    engine's construction path made it — committing it would conflict with
+    mesh-sharded co-arguments inside jit. Shared by the rollover staging
+    below and ``ServeEngine.append_vertices`` so the two paths cannot
+    drift."""
+    arr = jnp.asarray(new)
+    sharding = getattr(old, "sharding", None)
+    if sharding is not None and len(getattr(sharding, "device_set", ())) > 1:
+        arr = jax.device_put(arr, sharding)
+    return arr
+
+
+def params_mismatch(old, new) -> Optional[str]:
+    """None when ``new`` can replay ``old``'s executables (same treedef,
+    leaf shapes and dtypes); otherwise a human-readable reason. Anything
+    non-None would force an XLA recompile on adoption — the one cost a
+    hot swap exists to avoid — so it rejects instead."""
+    old_leaves, old_def = jax.tree.flatten(old)
+    new_leaves, new_def = jax.tree.flatten(new)
+    if old_def != new_def:
+        return f"param tree structure differs: {old_def} != {new_def}"
+    for i, (a, b) in enumerate(zip(old_leaves, new_leaves)):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.shape != b.shape or a.dtype != b.dtype:
+            return (
+                f"param leaf {i} differs: {a.shape}/{a.dtype} vs "
+                f"{b.shape}/{b.dtype}"
+            )
+    return None
+
+
+def nonfinite_param_leaves(params) -> int:
+    """Count of param leaves carrying any non-finite value — the rollover
+    analog of the training-side non-finite step guard
+    (:mod:`dgraph_tpu.train.guard`): a checkpoint that diverged before it
+    was saved must never reach traffic."""
+    bad = 0
+    for leaf in jax.tree.leaves(params):
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            bad += 1
+    return bad
+
+
+def _restore(engine, source, step):
+    from dgraph_tpu.train.checkpoint import restore_checkpoint
+
+    ckpt_dir = source if source is not None else engine.ckpt_dir
+    if not ckpt_dir:
+        raise SwapRejected(
+            "no checkpoint source: pass a directory (or params=) or build "
+            "the engine via from_checkpoint",
+            reason="no_source", rolled_back=False,
+        )
+    try:
+        state = restore_checkpoint(ckpt_dir, step=step)
+    except Exception as e:  # noqa: BLE001 — unreadable/corrupt checkpoint
+        raise SwapRejected(
+            f"checkpoint restore failed: {type(e).__name__}: {e}",
+            reason="restore_failed", ckpt_dir=ckpt_dir, rolled_back=False,
+        )
+    if state is None:
+        raise SwapRejected(
+            f"no checkpoint under {ckpt_dir!r}",
+            reason="not_found", ckpt_dir=ckpt_dir, rolled_back=False,
+        )
+    params = (
+        state["params"]
+        if isinstance(state, dict) and "params" in state
+        else state
+    )
+    restored_step = (
+        int(state["step"])
+        if isinstance(state, dict) and "step" in state
+        else step
+    )
+    return params, ckpt_dir, restored_step
+
+
+def swap_params(engine, source=None, *, step: Optional[int] = None,
+                params=None, parity_ids=None) -> dict:
+    """Run the full rollover state machine on ``engine``; returns the
+    adopted lineage record or raises :class:`SwapRejected` with the
+    rollback record (prior params still serving either way but rejection).
+
+    ``parity_ids``: explicit vertex ids for the served==eval oracle;
+    default is the first ``min(smallest bucket, num_nodes)`` real ids.
+    """
+    from dgraph_tpu import chaos
+
+    t0 = time.perf_counter()
+    rec = {
+        "kind": "serve_rollover",
+        "event": "swap",
+        "adopted": False,
+        "rolled_back": False,
+    }
+
+    def _reject(reason: str, detail: str, **ctx):
+        rec.update(reason=reason, detail=detail, rolled_back=True,
+                   swap_s=round(time.perf_counter() - t0, 3), **ctx)
+        engine.lineage.append(dict(rec))
+        engine.registry.counter("serve.swap_rejected")
+        raise SwapRejected(
+            f"checkpoint swap rolled back ({reason}): {detail}; prior "
+            "params remain installed",
+            **{k: v for k, v in rec.items() if k != "kind"},
+        )
+
+    # RESTORE (outside the engine lock: disk IO must not stall the worker)
+    if params is None:
+        try:
+            params, ckpt_dir, restored_step = _restore(engine, source, step)
+        except SwapRejected as e:
+            # restore-phase rejections land in the lineage too — the
+            # contract is ONE record per attempt, adopted or not
+            rec.update(
+                rolled_back=True,
+                reason=e.context.get("reason", "restore"),
+                detail=str(e),
+                ckpt_dir=e.context.get("ckpt_dir", source),
+                step=step,
+                swap_s=round(time.perf_counter() - t0, 3),
+            )
+            engine.lineage.append(dict(rec))
+            engine.registry.counter("serve.swap_rejected")
+            raise
+        rec.update(ckpt_dir=ckpt_dir, step=restored_step)
+    else:
+        rec.update(ckpt_dir=None, step=step)
+
+    try:
+        # the chaos boundary: a fault injected here (serve.swap=raise@0)
+        # exercises the mid-swap rollback path deterministically
+        chaos.fire("serve.swap")
+
+        # VALIDATE structure against the warmed executables
+        mismatch = params_mismatch(engine._params, params)
+        if mismatch:
+            _reject("structure_mismatch", mismatch)
+
+        # non-finite guard (host-side; the checkpoint may be freshly
+        # restored numpy — no device work yet)
+        bad = nonfinite_param_leaves(params)
+        if bad:
+            _reject(
+                "nonfinite_params",
+                f"{bad} param leaf(s) carry non-finite values",
+            )
+
+        # STAGE on device, leaf-by-leaf onto the LIVE params' shardings:
+        # a checkpoint restored on a different layout (host numpy, a
+        # single-device orbax restore, a different mesh at save time)
+        # must land exactly where the warmed executables expect their
+        # params operand, or validation would specialize a fresh
+        # executable — the recompile the swap exists to avoid. Every
+        # oracle below passes `staged` as an ARGUMENT through the cached
+        # executables; the live pointer has not moved
+        staged = jax.tree.map(place_like, params, engine._params)
+        compiles_before = engine._total_compiles()
+
+        # served == eval parity oracle: the full eval-forward of the NEW
+        # checkpoint vs the bucketed+gathered serving path, bit-for-bit
+        with jax.set_mesh(engine.mesh):
+            full = np.asarray(jax.block_until_ready(
+                engine._full(staged, engine._batch, engine._plan)
+            ))
+        if not np.isfinite(
+            full[engine._id_rank, engine._id_slot]
+        ).all():
+            _reject(
+                "nonfinite_logits",
+                "new checkpoint produces non-finite logits on real "
+                "vertices",
+            )
+        bucket = engine.ladder.sizes[0]
+        if parity_ids is None:
+            parity_ids = np.arange(
+                min(int(bucket), engine.num_nodes), dtype=np.int64
+            )
+        ids = np.asarray(parity_ids)
+        from dgraph_tpu.serve.bucketing import pad_ids
+
+        padded, n = pad_ids(ids, engine.ladder.bucket_for(ids.shape[0]))
+        rank_idx = jnp.asarray(engine._id_rank[padded])
+        slot_idx = jnp.asarray(engine._id_slot[padded])
+        with jax.set_mesh(engine.mesh):
+            served = np.asarray(jax.block_until_ready(
+                engine._forwards[engine.ladder.bucket_for(ids.shape[0])](
+                    staged, engine._batch, engine._plan, rank_idx, slot_idx
+                )
+            ))[:n]
+        ref = full[engine._id_rank[ids], engine._id_slot[ids]]
+        if not np.array_equal(served, ref):
+            _reject(
+                "parity",
+                "served logits diverge from the eval forward under the "
+                f"new checkpoint (max abs diff "
+                f"{float(np.abs(served - ref).max())})",
+            )
+
+        # jit-cache pin: adoption must not have minted executables
+        new_compiles = engine._total_compiles() - compiles_before
+        if new_compiles:
+            _reject(
+                "recompile",
+                f"{new_compiles} new jit-cache entries during validation "
+                "(the staged tree does not replay the warmed executables)",
+            )
+    except SwapRejected:
+        raise
+    except Exception as e:  # noqa: BLE001 — fault mid-swap: roll back
+        _reject("fault", f"{type(e).__name__}: {e}")
+
+    # ADOPT: one reference assignment under the engine lock — per-batch
+    # atomic (infer reads engine._params once per dispatch)
+    with engine._lock:
+        engine._params = staged
+    rec.update(adopted=True, swap_s=round(time.perf_counter() - t0, 3))
+    engine.lineage.append(dict(rec))
+    engine.registry.counter("serve.swaps_adopted")
+    engine.registry.gauge("serve.swap_s", rec["swap_s"])
+    return rec

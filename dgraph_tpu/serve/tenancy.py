@@ -1,0 +1,265 @@
+"""Per-tenant isolation: token-bucket rate quotas, bounded queue shares,
+and per-tenant degraded shedding.
+
+The single-tenant serving stack (PR 2/5) already bounds *total* overload —
+queue depth, deadlines, engine-level degraded mode — but one misbehaving
+tenant spends those shared bounds for everyone: a flood fills the queue and
+every other tenant sees ``backpressure``; a stream of poisoned payloads
+burns the retry budget and degrades the whole engine. This module makes
+each of those bounds *per tenant*, so the blast radius of one tenant's
+misbehavior is that tenant alone:
+
+- **Rate quota** — a :class:`TokenBucket` per tenant (``rps`` refill,
+  ``burst`` capacity). An empty bucket rejects at submit with the
+  structured :class:`~dgraph_tpu.serve.errors.QuotaExceeded` — the flood
+  never occupies a queue slot.
+- **Queue share** — each tenant may hold at most ``max_queue_share`` of
+  the batcher's bounded queue. A tenant at its share is rejected with
+  ``quota`` even when the queue has room, so a burst that fits the rate
+  quota still cannot starve other tenants of queue space.
+- **Per-tenant degraded** — ``degrade_after`` consecutive *failed* served
+  requests (the engine raised, not a quota rejection) flip just that
+  tenant into degraded shedding (:class:`~dgraph_tpu.serve.errors.
+  TenantDegraded`) until the operator calls :meth:`TenantTable.reset` —
+  PR 5's engine-level degraded mode, scoped to the tenant whose payloads
+  are failing.
+
+This module is **jax-free by contract** (``analysis.lint``'s
+``jax-free-module`` rule): quota bookkeeping is control-plane state the
+supervisor may inspect in processes that never dial a backend. Clocks are
+injectable (``clock=``) so every policy is testable deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional
+
+from dgraph_tpu.serve.errors import QuotaExceeded, TenantDegraded
+
+# the tenant id requests without an explicit tenant are accounted under;
+# quota enforcement applies to it like any other tenant
+DEFAULT_TENANT = "default"
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """Admission policy for one tenant (or the table-wide default).
+
+    ``rps`` / ``burst`` parameterize the token bucket (``rps <= 0`` means
+    unlimited rate); ``max_queue_share`` bounds the fraction of the
+    batcher's queue one tenant may occupy; ``degrade_after`` consecutive
+    served-request failures flip the tenant into degraded shedding
+    (``0`` disables per-tenant degrading).
+    """
+
+    rps: float = 0.0
+    burst: int = 8
+    max_queue_share: float = 0.5
+    degrade_after: int = 0
+
+    def __post_init__(self):
+        if self.burst < 1:
+            raise ValueError(f"burst must be >= 1, got {self.burst}")
+        if not 0.0 < self.max_queue_share <= 1.0:
+            raise ValueError(
+                f"max_queue_share must be in (0, 1], got {self.max_queue_share}"
+            )
+        if self.degrade_after < 0:
+            raise ValueError(
+                f"degrade_after must be >= 0, got {self.degrade_after}"
+            )
+
+
+class TokenBucket:
+    """Classic token bucket on an injectable monotonic clock.
+
+    ``take()`` consumes one token when available; refill is continuous at
+    ``rps`` up to ``burst`` capacity. Not thread-safe on its own — the
+    owning :class:`TenantTable` serializes access under its lock.
+    """
+
+    def __init__(self, rps: float, burst: int,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rps = float(rps)
+        self.burst = int(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._last = clock()
+
+    def take(self) -> bool:
+        if self.rps <= 0:
+            return True  # unlimited rate; queue share still bounds space
+        now = self._clock()
+        self._tokens = min(
+            self.burst, self._tokens + (now - self._last) * self.rps
+        )
+        self._last = now
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+
+class _TenantState:
+    __slots__ = (
+        "bucket", "quota", "queued", "admitted", "shed_quota",
+        "shed_degraded", "failures", "consecutive_failures", "degraded",
+    )
+
+    def __init__(self, quota: TenantQuota, clock):
+        self.quota = quota
+        self.bucket = TokenBucket(quota.rps, quota.burst, clock)
+        self.queued = 0  # requests currently occupying queue slots
+        self.admitted = 0
+        self.shed_quota = 0
+        self.shed_degraded = 0
+        self.failures = 0
+        self.consecutive_failures = 0
+        self.degraded = False
+
+
+class TenantTable:
+    """Thread-safe per-tenant admission + failure accounting.
+
+    The :class:`~dgraph_tpu.serve.batcher.MicroBatcher` consults
+    :meth:`admit` at submit (client threads) and reports outcomes from its
+    worker thread via :meth:`release` / :meth:`observe_failure` /
+    :meth:`observe_success`; :meth:`snapshot` feeds the per-tenant section
+    of ``serve_health_record``. Unknown tenants are admitted under
+    ``default_quota`` and materialize state lazily.
+    """
+
+    def __init__(
+        self,
+        default_quota: Optional[TenantQuota] = None,
+        quotas: Optional[dict] = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        max_tenants: int = 1024,
+    ):
+        if max_tenants < 1:
+            raise ValueError(f"max_tenants must be >= 1, got {max_tenants}")
+        self.default_quota = default_quota or TenantQuota()
+        self.max_tenants = int(max_tenants)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tenants: dict = {}
+        for name, q in (quotas or {}).items():
+            self._tenants[str(name)] = _TenantState(q, clock)
+
+    def _state(self, tenant: str) -> tuple:
+        """(resolved tenant id, state). Tenant ids are client-supplied, so
+        lazily-materialized state is CAPPED at ``max_tenants``: past the
+        cap, unseen ids fold into the shared :data:`DEFAULT_TENANT` bucket
+        (admission keeps working, bounded-memory, degraded-gracefully)
+        instead of letting an id-per-request client grow process memory
+        without bound."""
+        st = self._tenants.get(tenant)
+        if st is None:
+            if len(self._tenants) >= self.max_tenants:
+                tenant = DEFAULT_TENANT
+                st = self._tenants.get(tenant)
+            if st is None:
+                st = self._tenants[tenant] = _TenantState(
+                    self.default_quota, self._clock
+                )
+        return tenant, st
+
+    def admit(self, tenant: Optional[str], max_queue_depth: int) -> str:
+        """Admission check for one request; returns the resolved tenant id
+        or raises the structured rejection. On success the tenant's queue
+        occupancy is incremented — the caller MUST pair every successful
+        admit with exactly one :meth:`release` (whatever way the request
+        resolves)."""
+        t = DEFAULT_TENANT if tenant is None else str(tenant)
+        with self._lock:
+            t, st = self._state(t)
+            if st.degraded:
+                st.shed_degraded += 1
+                raise TenantDegraded(
+                    f"tenant {t!r} is degraded after "
+                    f"{st.consecutive_failures} consecutive request "
+                    "failures; shedding until reset",
+                    tenant=t,
+                    consecutive_failures=st.consecutive_failures,
+                )
+            share_cap = max(
+                1, int(st.quota.max_queue_share * max_queue_depth)
+            )
+            if st.queued >= share_cap:
+                st.shed_quota += 1
+                raise QuotaExceeded(
+                    f"tenant {t!r} holds {st.queued} of its {share_cap} "
+                    "queue slots; retry with backoff",
+                    tenant=t, reason="queue_share",
+                    queued=st.queued, share_cap=share_cap,
+                )
+            if not st.bucket.take():
+                st.shed_quota += 1
+                raise QuotaExceeded(
+                    f"tenant {t!r} exceeded its rate quota "
+                    f"({st.quota.rps} rps, burst {st.quota.burst})",
+                    tenant=t, reason="rate",
+                    rps=st.quota.rps, burst=st.quota.burst,
+                )
+            st.queued += 1
+            st.admitted += 1
+            return t
+
+    def release(self, tenant: str) -> None:
+        """The request admitted for ``tenant`` left the queue (served,
+        rejected, expired, cancelled, crashed — every resolution path)."""
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is not None and st.queued > 0:
+                st.queued -= 1
+
+    def observe_success(self, tenant: str) -> None:
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is not None:
+                st.consecutive_failures = 0
+
+    def observe_failure(self, tenant: str) -> bool:
+        """One served request from ``tenant`` failed in the engine; returns
+        True when this failure flipped the tenant into degraded mode."""
+        with self._lock:
+            _, st = self._state(str(tenant))
+            st.failures += 1
+            st.consecutive_failures += 1
+            if (
+                st.quota.degrade_after
+                and not st.degraded
+                and st.consecutive_failures >= st.quota.degrade_after
+            ):
+                st.degraded = True
+                return True
+            return False
+
+    def reset(self, tenant: str) -> None:
+        """Operator re-admission of a degraded tenant (mirrors
+        ``ServeEngine.reset_degraded`` — explicit on purpose; auto-undegrading
+        would flap against a client that is still sending poison)."""
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is not None:
+                st.degraded = False
+                st.consecutive_failures = 0
+
+    def snapshot(self) -> dict:
+        """Per-tenant counters for the serve_health record."""
+        with self._lock:
+            return {
+                t: {
+                    "admitted": st.admitted,
+                    "queued": st.queued,
+                    "shed_quota": st.shed_quota,
+                    "shed_degraded": st.shed_degraded,
+                    "failures": st.failures,
+                    "degraded": st.degraded,
+                }
+                for t, st in sorted(self._tenants.items())
+            }

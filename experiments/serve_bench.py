@@ -50,6 +50,20 @@ class Config:
     max_request: int = 128
     seed: int = 0
     log_path: str = "logs/serve_bench.jsonl"
+    # multi-tenant OPEN-LOOP mode (--tenants N): each tenant issues
+    # requests on its own fixed schedule regardless of completions (open
+    # loop — a flooding tenant keeps offering load while being shed, which
+    # is exactly the contention a closed loop can't create). tenant_rps is
+    # the OFFERED per-tenant rate (comma list, broadcast when single);
+    # quota_rps the per-tenant admission quota ("" = no rate cap, queue
+    # shares only). The report grows a per-tenant section with
+    # p99-under-contention — the tracked isolation artifact.
+    tenants: int = 0
+    tenant_rps: str = "20"
+    quota_rps: str = ""
+    quota_burst: int = 8
+    tenant_queue_share: float = 0.5
+    tenant_duration_s: float = 3.0
     # per-request span export (obs.spans): one kind="span" line per
     # request in the JSONL, sharing one trace id with the report — the
     # raw material for the queue-wait/infer/pad breakdown below. Off =
@@ -112,6 +126,23 @@ def _run(cfg: Config, log, trace_id) -> dict:
         max_queue_depth=cfg.max_queue_depth,
         request_timeout_s=cfg.request_timeout_s,
     )
+    if cfg.tenants > 0:
+        # per-tenant admission lives in the batcher: build the ONE stack
+        # with the TenantTable wired in
+        from dgraph_tpu.serve.tenancy import TenantQuota, TenantTable
+
+        quota_rps = _per_tenant(cfg.quota_rps, cfg.tenants, default=0.0)
+        table = TenantTable(quotas={
+            f"t{i}": TenantQuota(
+                rps=quota_rps[i], burst=cfg.quota_burst,
+                max_queue_share=cfg.tenant_queue_share,
+            )
+            for i in range(cfg.tenants)
+        })
+        engine, batcher, _g = build_serving(serve_cfg, tenants=table)
+        log.write(engine.warmup())
+        return _run_open_loop(cfg, log, trace_id, engine, batcher, table)
+
     engine, batcher, _g = build_serving(serve_cfg)
     log.write(engine.warmup())
 
@@ -180,6 +211,119 @@ def _run(cfg: Config, log, trace_id) -> dict:
         "buckets": [int(b) for b in engine.ladder.sizes],
         # the adopted tuning record (dgraph_tpu.tune) these throughput
         # numbers ran under, or None for the hard-coded defaults
+        "tuning_record": getattr(engine, "tuning_record_id", None),
+        "config": dataclasses.asdict(cfg),
+    }
+    log.write(report)
+    log.write(serve_health_record(engine, batcher))
+    return report
+
+
+def _per_tenant(spec: str, n: int, default: float) -> list:
+    """Parse a comma list of per-tenant floats; a single value broadcasts,
+    '' yields the default for every tenant."""
+    if not spec.strip():
+        return [float(default)] * n
+    vals = [float(v) for v in spec.split(",") if v.strip()]
+    if len(vals) == 1:
+        return vals * n
+    if len(vals) != n:
+        raise SystemExit(
+            f"need 1 or {n} comma-separated values, got {len(vals)}: {spec!r}"
+        )
+    return vals
+
+
+def _run_open_loop(cfg: Config, log, trace_id, engine, batcher, table) -> dict:
+    """Open-loop multi-tenant load: every tenant offers requests on its own
+    clock for ``tenant_duration_s``; completions are gathered out of band.
+    Emits per-tenant p50/p95/p99-under-contention into the report JSON so
+    isolation regressions (a noisy tenant inflating a quiet tenant's tail)
+    become a tracked artifact."""
+    import numpy as np
+
+    from dgraph_tpu.serve.errors import ServeError
+    from dgraph_tpu.serve.health import serve_health_record
+
+    rates = _per_tenant(cfg.tenant_rps, cfg.tenants, default=20.0)
+    offered = [0] * cfg.tenants
+    completed = [0] * cfg.tenants
+    rejected = [0] * cfg.tenants
+    futures: list = [[] for _ in range(cfg.tenants)]
+
+    def tenant_loop(i: int) -> None:
+        rng = np.random.default_rng(cfg.seed * 1000 + i)
+        interval = 1.0 / max(rates[i], 1e-6)
+        deadline = time.monotonic() + cfg.tenant_duration_s
+        next_at = time.monotonic()
+        while time.monotonic() < deadline:
+            now = time.monotonic()
+            if now < next_at:
+                time.sleep(min(next_at - now, 0.01))
+                continue
+            next_at += interval  # fixed schedule: OPEN loop, no backoff
+            n = int(rng.integers(cfg.min_request, cfg.max_request + 1))
+            ids = rng.integers(0, engine.num_nodes, n)
+            offered[i] += 1
+            try:
+                futures[i].append(batcher.submit(ids, tenant=f"t{i}"))
+            except ServeError:
+                rejected[i] += 1
+
+    threads = [
+        threading.Thread(target=tenant_loop, args=(i,), name=f"tenant-{i}")
+        for i in range(cfg.tenants)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(cfg.tenants):
+        for f in futures[i]:
+            try:
+                f.result(timeout=cfg.request_timeout_s)
+                completed[i] += 1
+            except Exception:  # noqa: BLE001 — queued-side rejection
+                rejected[i] += 1
+    wall_s = time.perf_counter() - t0
+    batcher.stop()
+
+    snap = engine.registry.snapshot()
+    q = ("count", "mean", "p50", "p95", "p99", "max")
+    tenant_stats = {}
+    table_snap = table.snapshot()
+    for i in range(cfg.tenants):
+        name = f"t{i}"
+        hist = snap["histograms"].get(
+            f"serve.tenant.{name}.request_ms", {}
+        )
+        tenant_stats[name] = {
+            "offered_rps": rates[i],
+            "offered": offered[i],
+            "completed": completed[i],
+            "rejected": rejected[i],
+            # p99 UNDER CONTENTION: the isolation SLO — a well-isolated
+            # quiet tenant keeps this flat while a noisy one floods
+            "latency_ms": {k: hist.get(k) for k in q} if hist else None,
+            **table_snap.get(name, {}),
+        }
+    total_completed = sum(completed)
+    report = {
+        "kind": "serve_bench",
+        "mode": "multi_tenant_open_loop",
+        "value": round(total_completed / wall_s, 2) if wall_s > 0 else None,
+        "throughput_rps": (
+            round(total_completed / wall_s, 2) if wall_s > 0 else None
+        ),
+        "wall_s": round(wall_s, 3),
+        "tenants": tenant_stats,
+        "offered": sum(offered),
+        "completed": total_completed,
+        "rejected": sum(rejected),
+        "trace_id": trace_id,
+        "recompiles_since_warmup": engine.recompiles_since_warmup(),
+        "buckets": [int(b) for b in engine.ladder.sizes],
         "tuning_record": getattr(engine, "tuning_record_id", None),
         "config": dataclasses.asdict(cfg),
     }

@@ -80,9 +80,19 @@ SECTIONS = [
     ("Serving: micro-batching", "dgraph_tpu.serve.batcher", ["MicroBatcher"]),
     ("Serving: errors & health", "dgraph_tpu.serve.errors",
      ["ServeError", "RequestTooLarge", "QueueFull", "RequestTimeout",
-      "EngineStopped"]),
+      "EngineStopped", "QuotaExceeded", "TenantDegraded", "SwapRejected"]),
     ("Serving: health record", "dgraph_tpu.serve.health",
      ["serve_health_record"]),
+    ("Serving: hot-swap rollover", "dgraph_tpu.serve.rollover",
+     ["swap_params", "params_mismatch", "nonfinite_param_leaves"]),
+    ("Serving: model registry", "dgraph_tpu.serve.registry",
+     ["ModelRegistry"]),
+    ("Serving: tenant isolation", "dgraph_tpu.serve.tenancy",
+     ["TenantTable", "TenantQuota", "TokenBucket", "DEFAULT_TENANT"]),
+    ("Serving: live graph deltas", "dgraph_tpu.serve.deltas",
+     ["init_world", "append_delta", "replan", "load_generation",
+      "build_engine", "read_world", "write_world", "assign_new_vertices",
+      "staged_delta_paths", "DeltaError"]),
     ("Timing & tracing", "dgraph_tpu.utils.timing", None),
     ("Observability: comm footprint", "dgraph_tpu.obs.footprint",
      ["plan_footprint", "dtype_bytes"]),
