@@ -934,14 +934,19 @@ extern "C" int64_t cluster_coarsen_c(const int64_t* src, const int64_t* dst,
 // edge-balance blend must use the same vw here as in the coarse stage,
 // or this refine's rebalance undoes the blend (measured: e_imb 1.14
 // pre-refine -> 1.25 after a unit-count refine at 2M power-law).
+// Returns 0 on success, -1 when build_csr32 refuses (vertex ids would
+// not fit int32) — mirroring cluster_coarsen_c's -1 so non-Python
+// callers cannot mistake a silent no-op for a refined partition
+// (ADVICE r5; the Python wrappers additionally pre-check the bound).
 namespace {
-void refine_csr_impl(const int64_t* src, const int64_t* dst,
-                     int64_t num_edges, int64_t num_vertices, int32_t W,
-                     int32_t passes, double imbalance, const int64_t* vw,
-                     int32_t* part) {
+int32_t refine_csr_impl(const int64_t* src, const int64_t* dst,
+                        int64_t num_edges, int64_t num_vertices, int32_t W,
+                        int32_t passes, double imbalance, const int64_t* vw,
+                        int32_t* part) {
   std::vector<int64_t> indptr;
   std::vector<int32_t> adj;
-  if (!build_csr32(src, dst, num_edges, num_vertices, indptr, adj)) return;
+  if (!build_csr32(src, dst, num_edges, num_vertices, indptr, adj))
+    return -1;
   int64_t total_w = 0;
   if (vw) {
     for (int64_t v = 0; v < num_vertices; ++v) total_w += vw[v];
@@ -985,25 +990,28 @@ void refine_csr_impl(const int64_t* src, const int64_t* dst,
     }
     if (!moves) break;
   }
+  return 0;
 }
 }  // namespace
 
-extern "C" void refine_unweighted_csr_c(const int64_t* src, const int64_t* dst,
-                                        int64_t num_edges,
-                                        int64_t num_vertices, int32_t W,
-                                        int32_t passes, double imbalance,
-                                        int32_t* part) {
-  refine_csr_impl(src, dst, num_edges, num_vertices, W, passes, imbalance,
-                  nullptr, part);
+extern "C" int32_t refine_unweighted_csr_c(const int64_t* src,
+                                           const int64_t* dst,
+                                           int64_t num_edges,
+                                           int64_t num_vertices, int32_t W,
+                                           int32_t passes, double imbalance,
+                                           int32_t* part) {
+  return refine_csr_impl(src, dst, num_edges, num_vertices, W, passes,
+                         imbalance, nullptr, part);
 }
 
-extern "C" void refine_weighted_csr_c(const int64_t* src, const int64_t* dst,
-                                      int64_t num_edges,
-                                      int64_t num_vertices, int32_t W,
-                                      int32_t passes, double imbalance,
-                                      const int64_t* vw, int32_t* part) {
-  refine_csr_impl(src, dst, num_edges, num_vertices, W, passes, imbalance,
-                  vw, part);
+extern "C" int32_t refine_weighted_csr_c(const int64_t* src,
+                                         const int64_t* dst,
+                                         int64_t num_edges,
+                                         int64_t num_vertices, int32_t W,
+                                         int32_t passes, double imbalance,
+                                         const int64_t* vw, int32_t* part) {
+  return refine_csr_impl(src, dst, num_edges, num_vertices, W, passes,
+                         imbalance, vw, part);
 }
 
 // Deduplicate (key, value) pairs encoded as key*stride+value, sorted.

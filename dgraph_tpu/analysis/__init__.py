@@ -56,11 +56,26 @@ CLI::
     python -m dgraph_tpu.analysis              # lint + audit (all tiers)
     python -m dgraph_tpu.analysis --selftest   # compile-free tier-1 smoke
 
+- :mod:`dgraph_tpu.analysis.host` — the **host-side concurrency &
+  durability auditor** (the fifth tier, and the only one that audits the
+  *host* program instead of the device program): stdlib-``ast`` race /
+  deadlock / torn-write rules over the jax-free control plane — per-class
+  guarded-field inference with out-of-lock access flagging (thread-escape
+  aware), the inter-class lock-acquisition-order graph (cycles RED), the
+  atomic-writer routing for durable artifacts, the pointer-flip-last CFG
+  check on generation commits, and the bidirectional chaos-registry
+  coverage drift check.
+
 This module deliberately imports neither jax nor numpy at module level:
-``lint`` is pure stdlib, and ``trace`` pulls jax in lazily so the CLI can
-pin the platform/device-count env before any backend decision is made.
+``lint`` and ``host`` are pure stdlib, and ``trace`` pulls jax in lazily
+so the CLI can pin the platform/device-count env before any backend
+decision is made.  Importing the package registers the host rules in
+``lint.RULES`` (one registry: ``--list_rules``, the docs-catalog pin and
+the ``# lint: allow(...)`` pragma cover all five tiers' rules).
 """
 
 from __future__ import annotations
 
-__all__ = ["hlo", "kernel", "lint", "spmd", "trace"]
+from dgraph_tpu.analysis import host  # noqa: F401  (registers host rules)
+
+__all__ = ["hlo", "host", "kernel", "lint", "spmd", "trace"]

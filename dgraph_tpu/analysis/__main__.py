@@ -1,6 +1,16 @@
 """``python -m dgraph_tpu.analysis`` — static-analysis CLI: contract
 linter + trace auditor + lowered-artifact (StableHLO) auditor + Pallas
-DMA-discipline verifier + cross-rank SPMD divergence auditor.
+DMA-discipline verifier + cross-rank SPMD divergence auditor + host-side
+concurrency & durability auditor.
+
+The host tier (``analysis.host``, ISSUE 15) audits the *other* program —
+the jax-free concurrent control plane: per-class guarded-field/lock
+discipline (races), the inter-class lock-acquisition-order graph
+(deadlocks), atomic-writer routing for durable artifacts and the
+pointer-flip-last commit contract (torn writes), and chaos-registry
+coverage drift.  Its per-file rules run inside the lint pass (one
+registry, one pragma); the repo-level graphs land in the report's
+``host_audit`` section.
 
 Default mode lints the whole ``dgraph_tpu`` tree and audits the canonical
 2-shard workload under every halo lowering at ALL verification tiers —
@@ -85,6 +95,7 @@ class Config:
     hlo: bool = True     # lowered-artifact (StableHLO) tier
     kernel: bool = True  # pallas_p2p DMA-discipline tier
     spmd: bool = True    # cross-rank SPMD divergence tier
+    host: bool = True    # host-side concurrency & durability tier
     root: str = ""  # lint root; "" = the repo containing this package
     world: int = 2  # audit world size (default mode)
     # bench-fallback workload shape (a reduced arxiv-like graph: the
@@ -704,6 +715,19 @@ def _selftest(cfg: Config, log) -> dict:
     spmd_summary = spmd_selftest(log, seed=cfg.seed)
     failures.extend(spmd_summary.pop("failures"))
 
+    # the host-side concurrency & durability tier: per-rule fixture
+    # pairs + the vacuity mutants (unlocked guarded-field write, seeded
+    # lock-order cycle, bare-open manifest write, pointer-flip-before-
+    # payload, unregistered chaos fire site — each must go RED) + the
+    # clean-tree audit — pure stdlib ast, zero compiles by construction
+    from dgraph_tpu.analysis.host import (
+        host_selftest_failures, run_host_audit,
+    )
+
+    failures.extend(host_selftest_failures(cfg.root or None))
+    host_audit = run_host_audit(cfg.root or None)
+    log.write(host_audit)
+
     return {
         "kind": "analysis_selftest",
         "failures": failures,
@@ -723,6 +747,12 @@ def _selftest(cfg: Config, log) -> dict:
                 "donation": rep["donation"],
             }
             for wld, rep in hlo_audits.items()
+        },
+        "host_audit": {
+            "ok": host_audit["ok"],
+            "files_checked": host_audit["files_checked"],
+            "lock_edges": host_audit["lock_edges"],
+            "chaos_points": host_audit["chaos_points"],
         },
         "spmd_audit": spmd_summary,
     }
@@ -830,6 +860,19 @@ def main(cfg: Config) -> dict:
             kernel_report = audit_workload_kernels(w)
             out["kernel_audit"] = kernel_report
             problems.extend(kernel_report["failures"])
+        if cfg.host:
+            # host-side concurrency & durability tier: the per-FILE host
+            # rules (lock discipline, durable writes, pointer-flip-last)
+            # already ran in the lint pass above — this section adds the
+            # repo-level graphs (lock-acquisition order, chaos-registry
+            # coverage) plus the structural summary
+            from dgraph_tpu.analysis.host import run_host_audit
+
+            host_report = run_host_audit(
+                cfg.root or None, file_rules=not cfg.lint
+            )
+            out["host_audit"] = host_report
+            problems.extend(host_report["failures"])
         if cfg.spmd:
             from dgraph_tpu.analysis.spmd import (
                 audit_plan_dir_spmd, build_spmd_fixture,

@@ -193,6 +193,10 @@ JAX_FREE_TARGETS = (
     "dgraph_tpu/serve/errors.py",
     "dgraph_tpu/serve/registry.py",
     "dgraph_tpu/serve/tenancy.py",
+    # the host-side concurrency/durability auditor is stdlib-ast by
+    # contract: it audits exactly the modules that must outlive a wedge,
+    # so it must never need a backend to run
+    "dgraph_tpu/analysis/host/",
 )
 
 

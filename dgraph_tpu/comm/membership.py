@@ -397,8 +397,13 @@ class Membership:
         """Graceful departure: publish a tombstone so peers see a clean
         ``left`` (a MembershipChanged without the lease wait) instead of a
         loss."""
+        # _seq is owned by the heartbeat lock (the background daemon
+        # advances it concurrently); snapshot under it rather than read
+        # a torn value mid-increment (host-lock-discipline)
+        with self._hb_lock:
+            seq = self._seq
         with open(self._left_path(self.rank), "w") as fh:
-            fh.write(str(self._seq))
+            fh.write(str(seq))
 
     # -- observation --------------------------------------------------------
 
@@ -581,8 +586,12 @@ class Membership:
         checkpoint write that peers need not wait out)."""
         bdir = self._barrier_dir(name)
         os.makedirs(bdir, exist_ok=True)
+        # same snapshot discipline as leave(): the heartbeat daemon owns
+        # _seq under _hb_lock
+        with self._hb_lock:
+            seq = self._seq
         with open(os.path.join(bdir, f"rank_{self.rank}"), "w") as fh:
-            fh.write(str(self._seq))
+            fh.write(str(seq))
 
     def barrier(
         self,

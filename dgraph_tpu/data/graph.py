@@ -64,6 +64,8 @@ class DistributedGraph:
         add_symmetric_norm: bool = False,
         pad_multiple: Optional[int] = None,
         seed: int = 0,
+        sample_frac: Optional[float] = None,
+        edge_balance: Optional[float] = None,
         partition_kwargs: Optional[dict] = None,
         plan_cache_dir: str = "",
         tune: str = "auto",
@@ -78,6 +80,16 @@ class DistributedGraph:
         them, else the hard-coded defaults (``"rcm"`` / ``8``) apply.
         Explicit values always win — adoption never overrides a caller's
         stated choice. ``tune="off"`` skips the lookup entirely.
+
+        ``sample_frac`` / ``edge_balance`` are the
+        ``method="multilevel_sampled"`` quality knobs (ADVICE r5: the
+        measured-good p100m blend — 0.35 sample fraction + edge-balance
+        vertex weights — was previously reachable only from
+        ``scripts/p100m_r5_stages.py``), forwarded to
+        :func:`~dgraph_tpu.partition.partition_graph` (which rejects
+        them for other methods) and folded into the plan-cache key so a
+        re-blended partition can never warm-hit a plan built under
+        different knobs.
         """
         if tune not in ("auto", "off"):
             raise ValueError(f"tune must be 'auto' or 'off', got {tune!r}")
@@ -123,9 +135,16 @@ class DistributedGraph:
             partition_method = "rcm"
         if pad_multiple is None:
             pad_multiple = 8
+        part_kwargs = dict(partition_kwargs or {})
+        # explicit first-class knobs win over a duplicate in
+        # partition_kwargs (the pre-plumbing spelling)
+        if sample_frac is not None:
+            part_kwargs["sample_frac"] = sample_frac
+        if edge_balance is not None:
+            part_kwargs["edge_balance"] = edge_balance
         new_edges, ren = pt.partition_graph(
             edge_index, num_nodes, world_size, method=partition_method,
-            seed=seed, **(partition_kwargs or {}),
+            seed=seed, **part_kwargs,
         )
         # the on-disk plan cache (train/checkpoint.cached_edge_plan) resolves
         # a falsy dir to a plain build, so this is the one call site either way
@@ -138,6 +157,14 @@ class DistributedGraph:
         overlap = True if (
             record is not None and record.config.get("halo_impl") == "overlap"
         ) else None
+        # partition knobs ride the cache key (key_extra folds into the
+        # fingerprint without reaching the plan builder): the partition
+        # CONTENT is hashed too, so this is belt-and-braces against two
+        # blends that happen to collide — and it makes the artifact name
+        # self-describing for cache forensics
+        key_extra = {"partition_method": partition_method}
+        for k, v in part_kwargs.items():
+            key_extra[f"part_{k}"] = v
         plan, layout = cached_edge_plan(
             plan_cache_dir,
             new_edges,
@@ -146,6 +173,7 @@ class DistributedGraph:
             edge_owner=edge_owner,
             pad_multiple=pad_multiple,
             overlap=overlap,
+            key_extra=key_extra,
         )
         n_pad = plan.n_src_pad
         feats = shard_vertex_data(

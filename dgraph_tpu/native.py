@@ -107,12 +107,15 @@ def _load():
             i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_double, i32p,
         ]
-        lib.refine_unweighted_csr_c.restype = None
+        # int status (0 ok, -1 = int32 CSR id bound refused), mirroring
+        # cluster_coarsen_c: a no-op refine must be detectable by any
+        # caller, not just the Python wrappers' pre-check (ADVICE r5)
+        lib.refine_unweighted_csr_c.restype = ctypes.c_int32
         lib.refine_weighted_csr_c.argtypes = [
             i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_double, i64p, i32p,
         ]
-        lib.refine_weighted_csr_c.restype = None
+        lib.refine_weighted_csr_c.restype = ctypes.c_int32
         lib.edge_cut_count.argtypes = [i64p, i64p, ctypes.c_int64, i32p]
         lib.edge_cut_count.restype = ctypes.c_int64
         f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
@@ -254,9 +257,16 @@ def refine_unweighted_csr(
     src = np.ascontiguousarray(edge_index[0], np.int64)
     dst = np.ascontiguousarray(edge_index[1], np.int64)
     part = np.ascontiguousarray(part, np.int32)
-    lib.refine_unweighted_csr_c(
+    status = lib.refine_unweighted_csr_c(
         src, dst, len(src), num_nodes, world_size, passes, imbalance, part
     )
+    # belt-and-braces behind the pre-check above: the C side now reports
+    # its refusal instead of silently returning the input unrefined
+    if status != 0:
+        raise RuntimeError(
+            f"refine_unweighted_csr_c returned status {status} (int32 "
+            "CSR id bound refused); partition left unrefined"
+        )
     return part
 
 
@@ -277,12 +287,17 @@ def refine_weighted_csr(
             "int32 CSR id bound (2^31-1)"
         )
     part = np.ascontiguousarray(part, np.int32)
-    lib.refine_weighted_csr_c(
+    status = lib.refine_weighted_csr_c(
         np.ascontiguousarray(edge_index[0], np.int64),
         np.ascontiguousarray(edge_index[1], np.int64),
         edge_index.shape[1], num_nodes, world_size, passes, imbalance,
         np.ascontiguousarray(vertex_w, np.int64), part,
     )
+    if status != 0:
+        raise RuntimeError(
+            f"refine_weighted_csr_c returned status {status} (int32 "
+            "CSR id bound refused); partition left unrefined"
+        )
     return part
 
 

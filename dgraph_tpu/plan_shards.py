@@ -195,6 +195,24 @@ def atomic_write_json(path: str, obj: dict) -> None:
     os.replace(tmp, path)
 
 
+def atomic_savez(path: str, **arrays) -> None:
+    """Durable atomic ``.npz`` write (savez to tmp + flush + fsync +
+    rename) for generation artifacts like ``graph_g<N>.npz`` — the numpy
+    sibling of :func:`atomic_write_json`.  A direct ``np.savez(path)``
+    can tear two ways on a host crash: a truncated archive under the
+    final name, or (with a hand-rolled tmp + rename that skips the
+    fsync) a rename committed before the bytes.  Shared by
+    ``train/shrink.py`` and ``serve/deltas.py`` so the two generation
+    machineries cannot drift (``analysis.host``'s ``host-durable-write``
+    rule enforces the routing)."""
+    tmp = path + f".tmp.{os.getpid()}.npz"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def write_manifest(plan_dir: str, manifest: dict) -> None:
     """Atomically write the manifest with a self-checksum (see
     :func:`atomic_write_json`)."""
@@ -555,6 +573,8 @@ def _selftest() -> dict:
             mpath = manifest_path(tmp)
             txt = open(mpath).read().replace('"complete": true',
                                              '"complete": false')
+            # deliberate non-atomic tamper: the selftest is TESTING the
+            # checksum's torn-write detection  # lint: allow(host-durable-write)
             open(mpath, "w").write(txt)
             try:
                 read_manifest(tmp)

@@ -307,7 +307,12 @@ class MicroBatcher:
                 if batch is None:
                     return
                 self._flush(batch)
-                self._inflight = []  # every future resolved; drop the refs
+                # every future resolved; drop the refs UNDER the cv —
+                # stop()/_worker_crashed read _inflight under it from
+                # other threads, and an unlocked reset here raced them
+                # (host-lock-discipline; pinned in test_analysis_host)
+                with self._cv:
+                    self._inflight = []
         except BaseException as e:  # noqa: BLE001 — fail pending, then die
             self._worker_crashed(e)
 

@@ -132,9 +132,13 @@ def write_world(run_dir: str, rec: dict) -> None:
 
 
 def _atomic_savez(path: str, **arrays) -> None:
-    tmp = path + ".tmp.npz"
-    np.savez(tmp, **arrays)
-    os.replace(tmp, path)
+    # ONE fsync+rename savez, shared with train/shrink.py (the local
+    # tmp+replace this used to hand-roll skipped the fsync — the rename
+    # could commit before the bytes; host-durable-write now enforces the
+    # shared writer)
+    from dgraph_tpu.plan_shards import atomic_savez
+
+    atomic_savez(path, **arrays)
 
 
 # ---------------------------------------------------------------------------

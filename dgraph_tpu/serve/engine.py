@@ -270,9 +270,27 @@ class ServeEngine:
         ids = np.asarray(node_ids)
         if ids.ndim != 1:
             raise ValueError(f"node_ids must be 1-D, got shape {ids.shape}")
-        if ids.size and (ids.min() < 0 or ids.max() >= self.num_nodes):
+        # ONE coherent control-plane snapshot under the engine lock: the
+        # degraded flag, the failure epoch, the id maps, and the batch
+        # reference all come from the same swap/append generation — and
+        # the lock is never held across a device dispatch.  Piecemeal
+        # unlocked reads here raced swap_params/append_vertices/
+        # reset_degraded (host-lock-discipline; pinned in
+        # tests/test_analysis_host.py).
+        with self._lock:
+            degraded = self.degraded
+            consecutive = self._consecutive_failures
+            # failure-epoch snapshot: if reset_degraded() lands while
+            # this request is in flight, its eventual failure belongs to
+            # the OLD epoch and must not count toward (or resurrect)
+            # degraded mode
+            epoch = self._failure_epoch
+            id_rank, id_slot = self._id_rank, self._id_slot
+            num_nodes = self.num_nodes
+            params, batch, plan = self._params, self._batch, self._plan
+        if ids.size and (ids.min() < 0 or ids.max() >= num_nodes):
             raise ValueError(
-                f"node ids must be in [0, {self.num_nodes}), got "
+                f"node ids must be in [0, {num_nodes}), got "
                 f"[{ids.min()}, {ids.max()}]"
             )
         # span parent = the batcher's ambient batch span when called from
@@ -280,14 +298,14 @@ class ServeEngine:
         # when tracing is off. The SAME span covers every retry, so the
         # trace id survives the retry/degraded paths.
         sp = spans.span("serve.infer", n=int(ids.shape[0]))
-        if self.degraded:
+        if degraded:
             self.registry.counter("serve.shed_degraded")
             sp.end(error="backpressure: degraded shed")
             raise QueueFull(
                 "engine degraded after repeated device failures; shedding "
                 "load (reset_degraded() to re-admit)",
                 degraded=True,
-                consecutive_failures=self._consecutive_failures,
+                consecutive_failures=consecutive,
             )
         t0 = time.perf_counter()
         try:
@@ -299,28 +317,23 @@ class ServeEngine:
         # pad stage: bucket pick + id padding + the FIRST index-operand
         # build (rebuilds inside the retry loop are failure-path cost and
         # stay inside the infer stage)
-        rank_idx = jnp.asarray(self._id_rank[padded])
-        slot_idx = jnp.asarray(self._id_slot[padded])
+        rank_idx = jnp.asarray(id_rank[padded])
+        slot_idx = jnp.asarray(id_slot[padded])
         pad_ms = (time.perf_counter() - t0) * 1e3
         t_infer = time.perf_counter()
         last_err = None
-        # failure-epoch snapshot: if reset_degraded() lands while this
-        # request is in flight, its eventual failure belongs to the OLD
-        # epoch and must not count toward (or resurrect) degraded mode
-        epoch = self._failure_epoch
         for attempt in range(self.max_retries + 1):
             if attempt:
                 # index operands are rebuilt per retry: they are DONATED to
                 # the executable, and a dispatch that failed midway may
                 # already have invalidated them
-                rank_idx = jnp.asarray(self._id_rank[padded])
-                slot_idx = jnp.asarray(self._id_slot[padded])
+                rank_idx = jnp.asarray(id_rank[padded])
+                slot_idx = jnp.asarray(id_slot[padded])
             try:
                 chaos.fire("serve.infer")
                 with jax.set_mesh(self.mesh):
                     out = self._forwards[bucket](
-                        self._params, self._batch, self._plan, rank_idx,
-                        slot_idx,
+                        params, batch, plan, rank_idx, slot_idx,
                     )
                 out = np.asarray(jax.block_until_ready(out))[:n]
                 break
@@ -337,6 +350,7 @@ class ServeEngine:
             with self._lock:
                 if epoch == self._failure_epoch:
                     self._consecutive_failures += 1
+                    consecutive = self._consecutive_failures
                     if (
                         self._consecutive_failures >= self.degrade_after
                         and not self.degraded
@@ -348,7 +362,7 @@ class ServeEngine:
                 self.registry.gauge("serve.degraded", 1.0)
                 print(
                     f"[serve] engine DEGRADED after "
-                    f"{self._consecutive_failures} consecutive infer "
+                    f"{consecutive} consecutive infer "
                     f"failures (last: {type(last_err).__name__}: {last_err})",
                     flush=True,
                 )
@@ -426,9 +440,13 @@ class ServeEngine:
         """Reserved pad capacity left for live vertex appends before the
         next re-plan must rebuild (``serve.deltas.replan``); 0 when the
         engine has no appendable batch."""
-        if self._host_x is None:
-            return 0
-        return int((self._host_x.shape[1] - self._slot_fill).sum())
+        # _host_x/_slot_fill are append_vertices' locked state; the lock
+        # is reentrant, so the in-lock error-message call below still
+        # works (host-lock-discipline)
+        with self._lock:
+            if self._host_x is None:
+                return 0
+            return int((self._host_x.shape[1] - self._slot_fill).sum())
 
     def append_vertices(self, features) -> np.ndarray:
         """Install new vertices into reserved pad slots, live — returns
@@ -445,16 +463,22 @@ class ServeEngine:
         appended vertex aggregates nothing — exactly an isolated vertex.
         Raises ValueError when the pad budget is exhausted (the signal to
         re-plan)."""
-        if self._host_x is None:
-            raise ValueError("engine batch has no 'x' leaf to append into")
-        feats = np.asarray(features, self._host_x.dtype)
-        if feats.ndim != 2 or feats.shape[1] != self._host_x.shape[2]:
-            raise ValueError(
-                f"features must be [k, {self._host_x.shape[2]}], got "
-                f"{feats.shape}"
-            )
-        k = int(feats.shape[0])
         with self._lock:
+            # validation INSIDE the lock too: the shape/dtype checks read
+            # _host_x, which a concurrent append is allowed to replace
+            # (host-lock-discipline); RLock keeps the nested
+            # free_pad_slots() call below legal
+            if self._host_x is None:
+                raise ValueError(
+                    "engine batch has no 'x' leaf to append into"
+                )
+            feats = np.asarray(features, self._host_x.dtype)
+            if feats.ndim != 2 or feats.shape[1] != self._host_x.shape[2]:
+                raise ValueError(
+                    f"features must be [k, {self._host_x.shape[2]}], got "
+                    f"{feats.shape}"
+                )
+            k = int(feats.shape[0])
             n_pad = self._host_x.shape[1]
             if k > int((n_pad - self._slot_fill).sum()):
                 raise ValueError(
@@ -504,16 +528,25 @@ class ServeEngine:
         """(rank, slot) arrays for original vertex ids — the row addresses
         of those vertices in any ``[W, n_pad, ...]`` sharded tensor (e.g.
         :meth:`full_logits`)."""
+        # one locked snapshot: append_vertices grows both maps together,
+        # and an unlocked pair of reads could see one grown and one not
+        # (host-lock-discipline)
+        with self._lock:
+            id_rank, id_slot = self._id_rank, self._id_slot
         ids = np.asarray(node_ids)
-        return self._id_rank[ids], self._id_slot[ids]
+        return id_rank[ids], id_slot[ids]
 
     def full_logits(self) -> np.ndarray:
         """[W, n_pad, C] logits for the whole graph — the parity oracle the
         selftest checks the bucketed path against bit-for-bit, and the bulk
         (batch-scoring) escape hatch. Row (r, s) serves original vertex id
         with ``id_rank==r, id_slot==s``."""
+        # same snapshot discipline as infer: one locked read of the
+        # swap/append-mutable references, lock released before dispatch
+        with self._lock:
+            params, batch, plan = self._params, self._batch, self._plan
         with jax.set_mesh(self.mesh):
-            out = self._full(self._params, self._batch, self._plan)
+            out = self._full(params, batch, plan)
         return np.asarray(jax.block_until_ready(out))
 
     # --- warmup / recompile accounting ---

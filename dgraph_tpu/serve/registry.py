@@ -133,7 +133,12 @@ class ModelRegistry:
 
     @property
     def active_name(self) -> Optional[str]:
-        return self._active
+        # under the lock like every other reader: _active is flipped by
+        # activate()/register() on operator threads, and an unlocked
+        # read here was the one hole in the registry's locking story
+        # (host-lock-discipline; pinned in test_analysis_host)
+        with self._lock:
+            return self._active
 
     @property
     def active_engine(self):

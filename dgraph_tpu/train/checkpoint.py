@@ -264,9 +264,18 @@ def cached_edge_plan(
     load_layout: Optional[bool] = None,
     memory_budget_bytes: Optional[int] = None,
     verify: bool = True,
+    key_extra: Optional[dict] = None,
     **build_kwargs: Any,
 ):
     """build_edge_plan with an on-disk **sharded** cache (format v8).
+
+    ``key_extra`` folds extra scalar knobs into the cache key WITHOUT
+    forwarding them to the plan builder — upstream decisions (the
+    partition method and its ``sample_frac``/``edge_balance`` blend)
+    that shaped the inputs but are not build kwargs.  The partition
+    content is hashed regardless; keying the knobs too keeps two blends
+    that collide on content from sharing one artifact name and makes
+    the cache directory self-describing.
 
     The cached artifact is a directory ``plan_<key>/`` of per-rank shard
     pickles plus a checksummed manifest (:mod:`dgraph_tpu.plan_shards`),
@@ -347,6 +356,10 @@ def cached_edge_plan(
         scatter_block_e=_plan.SCATTER_BLOCK_E,
         scatter_block_n=_plan.SCATTER_BLOCK_N,
         overlap=bool(overlap_resolved),
+        **{
+            f"x_{k}": v for k, v in sorted((key_extra or {}).items())
+            if v is not None and (np.isscalar(v) or isinstance(v, str))
+        },
         # write_layout is an artifact-shape knob, not a plan knob: the
         # shards are bit-identical either way, and the loader self-heals
         # a missing sidecar — keying on it would store a duplicate

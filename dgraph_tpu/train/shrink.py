@@ -158,13 +158,17 @@ def init_world(
     pointer write is last)."""
     from dgraph_tpu.partition import partition_graph
     from dgraph_tpu.plan import build_plan_shards
+    from dgraph_tpu.plan_shards import atomic_savez
 
     os.makedirs(run_dir, exist_ok=True)
     new_edges, ren = partition_graph(
         edge_index, num_nodes, world_size, method=partition_method,
         seed=seed,
     )
-    np.savez(
+    # fsync+rename, never a bare np.savez: a crash mid-write must not
+    # leave a torn graph_g0.npz under the name every later generation
+    # folds from (host-durable-write)
+    atomic_savez(
         graph_path(run_dir, 0),
         edge_index=new_edges,
         partition=ren.partition,
@@ -385,7 +389,10 @@ def shrink_world(run_dir: str, lost_ranks) -> dict:
                     {"state": new_states[r], "step": resume_step},
                     resume_step,
                 )
-        np.savez(
+        # atomic like the checkpoints above it: the graph snapshot is a
+        # payload the pointer flip below adopts, and a torn snapshot
+        # under a valid name would poison every later fold
+        ps.atomic_savez(
             graph_path(run_dir, new_gen),
             edge_index=new_edges,
             partition=ren.partition,

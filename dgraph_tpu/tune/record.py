@@ -155,14 +155,18 @@ class TuningRecord:
         return rec
 
     def save(self, directory: str) -> str:
-        """Atomic write to ``directory``; returns the path."""
+        """Atomic durable write to ``directory``; returns the path.
+
+        Routed through :func:`~dgraph_tpu.plan_shards.atomic_write_json`
+        (fsync before the rename): a tuning record silently truncated by
+        a host crash would otherwise be *adopted* as a corrupt-but-named
+        config on the next run (``analysis.host``'s
+        ``host-durable-write`` rule pins the routing)."""
+        from dgraph_tpu.plan_shards import atomic_write_json
+
         os.makedirs(directory, exist_ok=True)
         path = record_path(directory, self.signature)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, path)
+        atomic_write_json(path, self.to_dict())
         return path
 
     @classmethod

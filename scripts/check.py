@@ -39,6 +39,13 @@ PASSES = [
     ("kernel-verifier-selftest",
      [sys.executable, "-m", "dgraph_tpu.analysis.kernel",
       "--selftest", "true"]),
+    # host-side concurrency & durability auditor: guarded-field/lock
+    # discipline, lock-order cycles, atomic durable writes,
+    # pointer-flip-last commits, chaos-registry coverage — stdlib ast,
+    # zero compiles by construction (the vacuity mutants must go RED)
+    ("host-auditor-selftest",
+     [sys.executable, "-m", "dgraph_tpu.analysis.host",
+      "--selftest", "true"]),
     ("spans-selftest",
      [sys.executable, "-m", "dgraph_tpu.obs.spans", "--selftest", "true"]),
     # sharded plan artifacts (cache format v8): manifest/shard integrity,

@@ -134,6 +134,12 @@ SECTIONS = [
       "canonicalize_rank_modules", "collective_sequence",
       "resolution_agreement", "audit_plan_dir_spmd", "spmd_drift_record",
       "spmd_selftest"]),
+    ("Static analysis: host concurrency & durability auditor",
+     "dgraph_tpu.analysis.host",
+     ["scan_module", "class_concurrency_findings", "build_lock_graph",
+      "lock_order_findings", "durable_write_findings",
+      "pointer_flip_findings", "chaos_coverage_findings",
+      "run_host_audit", "host_selftest_failures"]),
     ("Static analysis: contract linter", "dgraph_tpu.analysis.lint",
      ["Finding", "Rule", "rule", "path_matcher", "lint_file", "run_lint"]),
     ("Config & flags", "dgraph_tpu.config", None),

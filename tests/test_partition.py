@@ -285,3 +285,100 @@ class TestMultilevelSampled:
         assert pt.edge_cut(edges, blend) < 0.9 * pt.edge_cut(
             edges, pt.random_partition(V, W)
         )
+
+
+class TestRefineStatus:
+    """ADVICE r5: the extern C refine entry points now return an int
+    status (0 ok, -1 = build_csr32 refused the int32 id bound) instead
+    of silently no-op'ing — plus the Python-side assertion layer."""
+
+    def test_raw_c_entry_reports_refusal(self):
+        from dgraph_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        lib = native._load()
+        src = np.zeros(0, np.int64)
+        dst = np.zeros(0, np.int64)
+        dummy = np.zeros(1, np.int32)
+        # num_vertices at the int32 bound: build_csr32 refuses BEFORE
+        # touching part, so the 1-element dummy is safe — and the
+        # caller now sees -1 instead of an unrefined partition
+        assert lib.refine_unweighted_csr_c(
+            src, dst, 0, 2**31, 2, 3, 1.03, dummy
+        ) == -1
+        vw = np.zeros(0, np.int64)
+        assert lib.refine_weighted_csr_c(
+            src, dst, 0, 2**31, 2, 3, 1.03, vw, dummy
+        ) == -1
+
+    def test_success_status_and_wrapper_precheck(self):
+        from dgraph_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        lib = native._load()
+        E = np.array([[0, 1, 2, 3], [1, 2, 3, 0]], np.int64)
+        part = np.ascontiguousarray([0, 0, 1, 1], np.int32)
+        assert lib.refine_unweighted_csr_c(
+            np.ascontiguousarray(E[0]), np.ascontiguousarray(E[1]),
+            4, 4, 2, 1, 1.03, part,
+        ) == 0
+        # the Python wrappers fail loudly before the C call ever runs
+        with pytest.raises(ValueError, match="int32 CSR id bound"):
+            native.refine_unweighted_csr(E, 2**31, 2, part.copy())
+        with pytest.raises(ValueError, match="int32 CSR id bound"):
+            native.refine_weighted_csr(
+                E, np.ones(4, np.int64), 2**31, 2, part.copy()
+            )
+
+
+class TestFromGlobalSampledKnobs:
+    """ISSUE 15 satellite: sample_frac/edge_balance are first-class
+    DistributedGraph.from_global kwargs (forwarded to partition_graph,
+    rejected for non-sampled methods) AND part of the plan-cache key —
+    a re-blended partition can never warm-hit a stale plan artifact."""
+
+    def _graph(self):
+        rng = np.random.default_rng(0)
+        E = rng.integers(0, 48, size=(2, 300))
+        X = rng.normal(size=(48, 4)).astype(np.float32)
+        return E, X
+
+    def test_knobs_reach_partitioner_and_cache_key(self, tmp_path):
+        import os
+
+        from dgraph_tpu.data.graph import DistributedGraph
+
+        E, X = self._graph()
+        kw = dict(partition_method="multilevel_sampled",
+                  plan_cache_dir=str(tmp_path), tune="off")
+        DistributedGraph.from_global(
+            E, X, None, None, 2, sample_frac=0.4, edge_balance=0.5, **kw
+        )
+        dirs = sorted(d for d in os.listdir(tmp_path)
+                      if d.startswith("plan_"))
+        assert len(dirs) == 1
+        # same knobs -> warm hit (same artifact); different blend -> a
+        # distinct artifact even if the partition happened to collide
+        DistributedGraph.from_global(
+            E, X, None, None, 2, sample_frac=0.4, edge_balance=0.5, **kw
+        )
+        assert sorted(
+            d for d in os.listdir(tmp_path) if d.startswith("plan_")
+        ) == dirs
+        DistributedGraph.from_global(
+            E, X, None, None, 2, sample_frac=0.9, **kw
+        )
+        assert len([d for d in os.listdir(tmp_path)
+                    if d.startswith("plan_")]) == 2
+
+    def test_rejected_for_other_methods(self):
+        from dgraph_tpu.data.graph import DistributedGraph
+
+        E, X = self._graph()
+        with pytest.raises(ValueError, match="multilevel_sampled"):
+            DistributedGraph.from_global(
+                E, X, None, None, 2, partition_method="rcm",
+                sample_frac=0.5, tune="off",
+            )
