@@ -56,6 +56,7 @@ _HEALTH_MOD = None
 _HEALTH = None  # this process's RunHealth (child or supervisor)
 _SPANS_MOD = None
 _SUPERVISE_MOD = None
+_LEDGER_MOD = None
 
 
 def _load_standalone(name: str, *relpath: str):
@@ -98,6 +99,41 @@ def _spans_mod():
             "_dgraph_obs_spans", "dgraph_tpu", "obs", "spans.py"
         )
     return _SPANS_MOD
+
+
+def _ledger_mod():
+    """obs/ledger.py, standalone (stdlib-only by the same lint-enforced
+    contract): the perf-trajectory ledger. Registered as
+    ``_dgraph_obs_ledger`` so supervise.py's lineage hook finds the same
+    twin via sys.modules instead of importing the (jax-pulling)
+    package."""
+    global _LEDGER_MOD
+    if _LEDGER_MOD is None:
+        _LEDGER_MOD = _load_standalone(
+            "_dgraph_obs_ledger", "dgraph_tpu", "obs", "ledger.py"
+        )
+    return _LEDGER_MOD
+
+
+def _git_rev() -> str:
+    """The commit every round JSON is stamped with (obs.health.git_rev:
+    subprocess ``git rev-parse --short HEAD``, ``"unknown"`` fallback)."""
+    try:
+        return _health_mod().git_rev()
+    except Exception:
+        return "unknown"
+
+
+def _ledger_ingest(out: dict) -> None:
+    """Append the round's record to the perf ledger. Bench is the one
+    emitter where the DGRAPH_LEDGER_DIR knob defaults ON (a bench round
+    not in the trajectory is the empty-ledger problem all over again);
+    maybe_ingest swallows every failure — the ledger must never cost
+    the round's JSON line."""
+    try:
+        _ledger_mod().maybe_ingest(out, source="bench", default_on=True)
+    except Exception as e:
+        log(f"ledger ingest failed (ignored): {type(e).__name__}: {e}")
 
 
 def _supervise_mod():
@@ -698,6 +734,9 @@ def _failure_json(error: str, state: dict, empty_rc: int, wedge=None):
     out = {
         "metric": "arxiv_gcn_epoch_time", "value": None, "unit": "ms",
         "vs_baseline": None, "error": error,
+        # even a null round is attributable to a commit (the ledger's
+        # bisect key)
+        "git_rev": _git_rev(),
     }
     out.update(state)  # keep any stage that DID finish
     if _HEALTH is not None:
@@ -939,6 +978,7 @@ def _child_main():
         "metric": "arxiv_gcn_epoch_time",
         "value": round(dt_ms, 3) if dt_ms == dt_ms else None,
         "unit": "ms",
+        "git_rev": _git_rev(),
         "vs_baseline": vs,
         **roof,
         "hbm_peak_gb_gcn": hbm_gcn,
@@ -969,6 +1009,10 @@ def _supervisor_emit(state: dict, error: str, wedge=None) -> int:
     out, rc = _failure_json(error, state, EXIT_EMPTY, wedge)
     print(json.dumps(out))
     sys.stdout.flush()
+    # the supervisor-side failure paths are one of the two places a
+    # round's final JSON exists exactly once — ledger it here (the other
+    # is the child pass-through in _main_guarded)
+    _ledger_ingest(out)
     return rc
 
 
@@ -1319,6 +1363,9 @@ def _main_guarded(budget, deadline, read_state, child_proc, state_path) -> int:
                 out.setdefault("run_health", {})["supervisor"] = (
                     _HEALTH.finish())
                 line = json.dumps(out)
+                # ledger the MERGED record (child metrics + supervisor
+                # probe history) — this is the round's artifact of record
+                _ledger_ingest(out)
             except ValueError:
                 pass  # not JSON: pass the child's words through untouched
             print(line)

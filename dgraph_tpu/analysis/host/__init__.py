@@ -133,6 +133,10 @@ HOST_SCOPE = (
 DURABLE_SCOPE = HOST_SCOPE + (
     "dgraph_tpu/train/checkpoint.py",
     "dgraph_tpu/tune/record.py",
+    # the perf-trajectory ledger: an append-only store that must survive
+    # host crashes mid-append (torn trailing lines are tolerated by its
+    # reader, but the append itself must flush+fsync)
+    "dgraph_tpu/obs/ledger.py",
 )
 
 LOCK_CONSTRUCTORS = frozenset({"Lock", "RLock", "Condition"})
@@ -154,16 +158,21 @@ ATTR_RESOLUTION_BLOCKLIST = frozenset({
     "strip", "split", "sleep",
 })
 
-# blessed durable writers (tmp + flush + fsync + os.replace inside)
+# blessed durable writers (tmp + flush + fsync + os.replace inside; the
+# ledger's append variant flush+fsyncs the appended line instead — its
+# reader skips a torn trailing line with a reason, so append is durable)
 ATOMIC_WRITERS = frozenset({
     "atomic_write_json", "atomic_pickle_dump", "atomic_savez",
+    "atomic_append_jsonl",
 })
 
 # path-returning helpers whose results name durable artifacts
 DURABLE_PATH_FNS = frozenset({
     "world_path", "graph_path", "manifest_path", "record_path",
+    "ledger_path",
 })
-DURABLE_NAME_HINTS = ("world.json", "serving.json", "manifest.json")
+DURABLE_NAME_HINTS = ("world.json", "serving.json", "manifest.json",
+                      "ledger.jsonl")
 
 # calls that touch the filesystem, for the pointer-flip-last walk
 FS_EFFECT_CALLS = frozenset({
@@ -1367,6 +1376,24 @@ _DURABLE_FIXTURE = {
     ),
 }
 
+_LEDGER_DURABLE_FIXTURE = {
+    "path": "dgraph_tpu/obs/ledger.py",
+    # a bare append onto the ledger: a host crash mid-write tears the
+    # line with nothing fsynced behind it
+    "bad": (
+        "import json\n"
+        "def append(d, recs):\n"
+        "    fh = open(ledger_path(d), 'a')\n"
+        "    for r in recs:\n"
+        "        fh.write(json.dumps(r) + '\\n')\n"
+    ),
+    # the blessed shape: the append writer flush+fsyncs before returning
+    "good": (
+        "def append(d, recs):\n"
+        "    atomic_append_jsonl(ledger_path(d), recs)\n"
+    ),
+}
+
 _FLIP_FIXTURE = {
     "path": "dgraph_tpu/train/shrink.py",
     # pointer-flip-before-payload: the world pointer moves, THEN the
@@ -1472,6 +1499,16 @@ def host_selftest_failures(root: Optional[str] = None) -> list:
                         _DURABLE_FIXTURE["good"])
     check(not got, f"host-durable-write false-positived on the atomic "
                    f"writers: {got}")
+    got = run_file_rule("host-durable-write",
+                        _LEDGER_DURABLE_FIXTURE["path"],
+                        _LEDGER_DURABLE_FIXTURE["bad"])
+    check(got, "host-durable-write missed a bare open(ledger_path, 'a') "
+               "(ledger-append vacuity mutant stayed GREEN)")
+    got = run_file_rule("host-durable-write",
+                        _LEDGER_DURABLE_FIXTURE["path"],
+                        _LEDGER_DURABLE_FIXTURE["good"])
+    check(not got, f"host-durable-write false-positived on "
+                   f"atomic_append_jsonl: {got}")
 
     # --- host-pointer-flip-last ---
     got = run_file_rule("host-pointer-flip-last", _FLIP_FIXTURE["path"],

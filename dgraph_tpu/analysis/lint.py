@@ -197,6 +197,13 @@ JAX_FREE_TARGETS = (
     # contract: it audits exactly the modules that must outlive a wedge,
     # so it must never need a backend to run
     "dgraph_tpu/analysis/host/",
+    # the perf-trajectory ledger + drift sentinel + report: the
+    # longitudinal store is read/written by bench's supervisor and by
+    # operators on machines where jax is wedged or absent, so the whole
+    # pipeline (normalize, gate, render) is stdlib-only by contract
+    "dgraph_tpu/obs/ledger.py",
+    "dgraph_tpu/obs/regress.py",
+    "dgraph_tpu/obs/report.py",
 )
 
 

@@ -105,6 +105,33 @@ def _active_trace_id() -> Optional[str]:
     return os.environ.get("DGRAPH_TRACE_ID") or None
 
 
+_GIT_REV: Optional[str] = None
+
+
+def git_rev() -> str:
+    """The current ``git rev-parse --short HEAD`` of the repo this file
+    lives in, or ``"unknown"`` (no git, no .git dir, detached tarball —
+    never an exception). Cached per process; stamped into every
+    :class:`RunHealth` record and bench round JSON so a perf artifact is
+    attributable to a commit (the ledger keys on it; any bisect wants
+    it)."""
+    global _GIT_REV
+    if _GIT_REV is None:
+        import subprocess
+
+        try:
+            p = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=5,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            rev = (p.stdout or "").strip()
+            _GIT_REV = rev if p.returncode == 0 and rev else "unknown"
+        except Exception:
+            _GIT_REV = "unknown"
+    return _GIT_REV
+
+
 def _host_snapshot() -> dict:
     import platform
     import socket
@@ -164,6 +191,9 @@ class RunHealth:
     # key against supervise_lineage / span / step JSONL; None otherwise.
     # Additive to schema 1 (readers ignore unknown fields).
     trace_id: Optional[str] = None
+    # the commit the record was produced at (git_rev(); "unknown" outside
+    # a checkout) — the ledger's bisect key. Additive to schema 1.
+    git_rev: Optional[str] = None
     schema: int = SCHEMA_VERSION
     _t0: float = dataclasses.field(default=0.0, repr=False)
 
@@ -175,6 +205,7 @@ class RunHealth:
             host=_host_snapshot(),
             env=_env_snapshot(),
             trace_id=_active_trace_id(),
+            git_rev=git_rev(),
             _t0=time.perf_counter(),
         )
 

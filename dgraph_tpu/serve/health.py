@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Optional
 
 from dgraph_tpu.obs.health import RunHealth
+from dgraph_tpu.obs.ledger import SERVE_HEALTH_SCHEMA_VERSION, maybe_ingest
 from dgraph_tpu.obs.metrics import Metrics
 
 # the registry histograms surfaced as headline latency numbers, in
@@ -65,6 +66,9 @@ def serve_health_record(
             stages[stage] = hist
     rec = {
         "kind": "serve_health",
+        # versioned against the ledger normalizer (one shared constant):
+        # readers skip-with-reason on records newer than they understand
+        "schema_version": SERVE_HEALTH_SCHEMA_VERSION,
         **h.finish(),
         "buckets": [int(b) for b in engine.ladder.sizes],
         "num_nodes": engine.num_nodes,
@@ -105,4 +109,8 @@ def serve_health_record(
         source = getattr(batcher, "_source", None)
         if source is not None and hasattr(source, "active_engine"):
             rec["models"] = source.record()
+    # longitudinal trajectory: serving latency joins the perf ledger when
+    # DGRAPH_LEDGER_DIR is set (off by default — a serving process must
+    # not write to a bench cache it doesn't own)
+    maybe_ingest(rec, source="serve.health", default_on=False)
     return rec

@@ -139,6 +139,48 @@ def _append_jsonl(path: str, rec: dict) -> None:
         fh.write(json.dumps(rec) + "\n")
 
 
+def _ledger_mod():
+    """The perf-ledger module without ever importing the dgraph_tpu
+    package (whose ``__init__`` imports jax — the supervisor contract).
+    Prefers an already-loaded twin (package import or bench's standalone
+    ``_dgraph_obs_ledger``), else path-loads ledger.py standalone; None
+    when unavailable (lineage emission must never depend on it)."""
+    for name in ("dgraph_tpu.obs.ledger", "_dgraph_obs_ledger"):
+        mod = sys.modules.get(name)
+        if mod is not None:
+            return mod
+    try:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            os.pardir, "obs", "ledger.py",
+        )
+        spec = importlib.util.spec_from_file_location(
+            "_dgraph_obs_ledger", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_dgraph_obs_ledger"] = mod
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None
+
+
+def _ledger_ingest(lineage: dict) -> None:
+    """Best-effort perf-ledger hook for a sealed lineage record: off by
+    default (DGRAPH_LEDGER_DIR opts in), and no failure mode — the
+    ledger is a passenger on the supervisor, never a dependency."""
+    try:
+        mod = _ledger_mod()
+        if mod is not None:
+            mod.maybe_ingest(
+                lineage, source="train.supervise", default_on=False
+            )
+    except Exception:
+        pass
+
+
 def supervise(
     argv: list,
     *,
@@ -718,6 +760,7 @@ def main(cfg: Config) -> dict:
             ckpt_dir=cfg.ckpt_dir,
         )
         _append_jsonl(cfg.log_path, lineage)
+        _ledger_ingest(lineage)
         print(json.dumps(lineage, indent=cfg.indent or None), flush=True)
         if lineage["final_exit_code"] != 0:
             sys.exit(lineage["final_exit_code"])
@@ -736,6 +779,7 @@ def main(cfg: Config) -> dict:
         ckpt_dir=cfg.ckpt_dir,
     )
     _append_jsonl(cfg.log_path, lineage)
+    _ledger_ingest(lineage)
     # the lineage is ALWAYS the last stdout line, parseable on every exit
     # path (the bench-supervisor contract pinned by tests)
     print(json.dumps(lineage, indent=cfg.indent or None), flush=True)

@@ -263,4 +263,13 @@ def adopt_record(rec: TuningRecord) -> dict:
         rec.record_id, rec.phase,
         {k: v for k, v in rec.config.items() if k != "serve"},
     )
+    # longitudinal trajectory: each adoption joins the perf ledger when
+    # DGRAPH_LEDGER_DIR is set (off by default; maybe_ingest swallows
+    # every failure — adoption must never break on observability)
+    from dgraph_tpu.obs.ledger import maybe_ingest
+
+    maybe_ingest(
+        {"kind": "tune_record", **rec.to_dict()},
+        source="tune.adopt", default_on=False,
+    )
     return {k: rec.config[k] for k in _BUILD_KEYS if k in rec.config}

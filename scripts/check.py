@@ -70,6 +70,13 @@ PASSES = [
     ("spmd-selftest",
      [sys.executable, "-m", "dgraph_tpu.analysis.spmd",
       "--selftest", "true"]),
+    # perf-trajectory drift sentinel: the four seeded-drift vacuity
+    # mutants (inflated wire bytes, slowed scan-delta, fattened p99,
+    # dropped fallback tier) must each go RED and the clean fixture
+    # ledger must gate GREEN — pure stdlib, zero compiles
+    ("regress-selftest",
+     [sys.executable, "-m", "dgraph_tpu.obs.regress",
+      "--selftest", "true"]),
 ]
 
 EXTRA_SELFTESTS = [

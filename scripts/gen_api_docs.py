@@ -106,6 +106,16 @@ SECTIONS = [
       "export_perfetto"]),
     ("Observability: step-time attribution", "dgraph_tpu.obs.attribution",
      ["scan_delta_attribution", "multichip_family_table"]),
+    ("Observability: perf-trajectory ledger", "dgraph_tpu.obs.ledger",
+     ["normalize_record", "ingest", "maybe_ingest", "read_ledger",
+      "backfill", "resolve_ledger_dir", "atomic_append_jsonl",
+      "ledger_path", "LEDGER_SCHEMA_VERSION",
+      "SERVE_HEALTH_SCHEMA_VERSION"]),
+    ("Observability: drift sentinel", "dgraph_tpu.obs.regress",
+     ["check_ledger", "metric_class", "baseline_stats",
+      "dropped_tier_verdicts"]),
+    ("Observability: trajectory report", "dgraph_tpu.obs.report",
+     ["render_trajectory", "sparkline"]),
     ("Autotuning: signatures", "dgraph_tpu.tune.signature",
      ["graph_signature", "signature_key", "degree_histogram"]),
     ("Autotuning: records & adoption", "dgraph_tpu.tune.record",
