@@ -557,9 +557,12 @@ def bench_gcn(dtype_name: str):
     from dgraph_tpu import config as _dcfg
     from dgraph_tpu.plan import resolve_halo_impl
 
+    _schedule = getattr(plan_np, "halo_schedule", None)
     halo_impl, halo_impl_source = resolve_halo_impl(
         plan_np.world_size, plan_np.halo_deltas,
         overlap_available=plan_np.overlap is not None,
+        sched_available=_schedule is not None,
+        pair_rows=getattr(plan_np, "halo_pair_rows", ()),
     )
     split_info = {
         "interior_edge_frac": round(edge_split["interior_frac"], 4),
@@ -568,7 +571,27 @@ def bench_gcn(dtype_name: str):
         "halo_impl": halo_impl,
         "halo_impl_source": halo_impl_source,
         "halo_impl_env_pin": _dcfg.halo_impl,
+        # compiled-schedule identity (dgraph_tpu.sched): the content hash
+        # names the exact round order this plan would replay under
+        # halo_impl='sched', whether or not sched was the resolved impl
+        "halo_schedule_id": _schedule.schedule_id if _schedule else None,
+        "halo_schedule_rounds": _schedule.num_rounds if _schedule else 0,
     }
+    if _schedule is not None:
+        # the compiled schedule joins the perf ledger as its own record
+        # kind (regress byte-exact-gates rounds/bytes across commits);
+        # _ledger_ingest swallows failures, same as the round JSON
+        _ledger_ingest({
+            "kind": "sched_compile",
+            "workload": {"world_size": plan_np.world_size,
+                         "nodes": Vp, "hidden": H},
+            "schedule_id": _schedule.schedule_id,
+            "rounds": _schedule.num_rounds,
+            "transfers": _schedule.num_transfers,
+            "operand_bytes_per_shard": sum(_schedule.round_rows()) * H * b,
+            "round_rows": list(_schedule.round_rows()),
+            "git_rev": _git_rev(),
+        })
     if dt_ms != dt_ms:  # NaN timing: no roofline numbers (keep JSON valid;
         # the record id still rides along — a null metric must stay
         # attributable to the config that failed to produce it)

@@ -204,6 +204,13 @@ JAX_FREE_TARGETS = (
     "dgraph_tpu/obs/ledger.py",
     "dgraph_tpu/obs/regress.py",
     "dgraph_tpu/obs/report.py",
+    # the halo schedule compiler core (IR + passes + selftest): the
+    # schedule is DATA — compiled, verified, serialized, and diffed on
+    # hosts with no backend (plan tooling, regress, operators reading a
+    # manifest), so everything except the executor stays stdlib-only.
+    # comm/collectives.py replays the schedule and is the ONE jax
+    # consumer, deliberately outside this list.
+    "dgraph_tpu/sched/",
 )
 
 

@@ -115,6 +115,10 @@ _STATIC_FIELDS = (
     "world_size", "n_src_pad", "n_dst_pad", "e_pad", "halo_side",
     "homogeneous", "owner_sorted", "halo_deltas", "scatter_mc",
     "scatter_block_e", "scatter_block_n", "halo_sort_mc", "gather_mv",
+    # the FULL-WORLD traffic matrix and the schedule compiled from it
+    # (dgraph_tpu.sched): a rank whose matrix row drifted compiles a
+    # different round order — the deadlock class the sched lowering adds
+    "halo_pair_rows", "halo_schedule",
 )
 
 
@@ -498,6 +502,8 @@ def resolution_agreement(
     halo_deltas: tuple,
     *,
     overlap_available: bool,
+    sched_available: bool = False,
+    pair_rows: tuple = (),
     rank_tuned: Optional[Dict[int, Optional[str]]] = None,
     failures: Optional[list] = None,
 ) -> dict:
@@ -523,6 +529,8 @@ def resolution_agreement(
                     world_size, tuple(halo_deltas),
                     overlap_available=overlap_available,
                     p2p_available=True,
+                    sched_available=sched_available,
+                    pair_rows=pair_rows,
                 )
                 out[r] = [impl, source]
     finally:
@@ -604,6 +612,8 @@ def audit_plan_dir_spmd(
     # tuned-record resolution agreement (each rank under its own record)
     resolution = resolution_agreement(
         W, halo_deltas, overlap_available=base.get("overlap", False),
+        sched_available=base.get("halo_schedule") is not None,
+        pair_rows=base.get("halo_pair_rows", ()),
         rank_tuned=rank_tuned, failures=failures,
     )
 
@@ -618,8 +628,12 @@ def audit_plan_dir_spmd(
     program_records: list = []
     saved = (_cfg.halo_impl, _cfg.tuned_halo_impl, _cfg.use_pallas_p2p)
     schedule_ok = True
+    audited_impls = [
+        i for i in impls
+        if i != "sched" or base.get("halo_schedule") is not None
+    ]
     try:
-        for impl in impls:
+        for impl in audited_impls:
             _cfg.set_flags(halo_impl=impl, tuned_halo_impl=None)
             _cfg.set_flags(
                 use_pallas_p2p=True if impl == "pallas_p2p" else saved[2]
@@ -717,7 +731,7 @@ def audit_plan_dir_spmd(
         "world_size": W,
         "num_halo_deltas": len(halo_deltas),
         "halo_deltas": list(halo_deltas),
-        "impls": list(impls),
+        "impls": list(audited_impls),
         "programs": program_records,
         "statics_agree": not any("statics" in f for f in failures),
         "per_rank_live_deltas": {

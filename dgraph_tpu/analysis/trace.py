@@ -40,7 +40,7 @@ import dataclasses
 import math
 from typing import Any, Callable, Optional
 
-HALO_IMPLS = ("all_to_all", "ppermute", "overlap", "pallas_p2p")
+HALO_IMPLS = ("all_to_all", "ppermute", "overlap", "pallas_p2p", "sched")
 
 # psum family across jax versions: 'psum' (0.6+), 'psum2'/'pbroadcast'
 # (0.4.x shard_map rewrite); pmean lowers through psum
@@ -399,12 +399,16 @@ def _expected_bytes(plan, dtype: str, feat_dim: int) -> dict:
         fp["halo"]["wire_bytes_per_shard"]["ppermute"] // n_deltas
         if n_deltas else 0
     )
+    sched_fp = ex.get("sched") or {}
     return {
         "a2a_operand_bytes": ex["a2a_operand_bytes_per_shard"],
         "ppermute_round_bytes": per_round,
         # the p2p transport's one [n_deltas, S, F] send-tile stack — the
         # same boundary-only bytes the ppermute rounds move in total
         "p2p_operand_bytes": fp["halo"]["wire_bytes_per_shard"]["pallas_p2p"],
+        # the compiled schedule's per-round operand bytes (rounds differ
+        # in height, so this is a LIST — the audit compares multisets)
+        "sched_round_bytes": list(sched_fp.get("round_bytes_per_shard", [])),
         "num_halo_deltas": n_deltas,
     }
 
@@ -455,6 +459,26 @@ def _audit_one_program(
     for rec in coll[want_family]:
         feat = rec["shape"][-1] if rec["shape"] else 0
         exp = _expected_bytes(plan, rec["dtype"], feat)
+        if impl == "sched":
+            # compiled-schedule rounds differ in height, so each traced
+            # operand must be SOME priced round (membership here); the
+            # full multiset equality — every round present exactly legs
+            # times — is pinned cross-program in audit_workload
+            allowed = set(exp["sched_round_bytes"])
+            member = rec["bytes"] in allowed
+            byte_rows.append({
+                "primitive": rec["primitive"], "shape": rec["shape"],
+                "dtype": rec["dtype"], "traced_bytes": rec["bytes"],
+                "footprint_bytes": rec["bytes"] if member else 0,
+            })
+            if not member:
+                fail(
+                    f"{rec['primitive']} operand {rec['shape']} "
+                    f"({rec['dtype']}) carries {rec['bytes']} B; footprint "
+                    f"prices rounds of {sorted(allowed)} B — the traced "
+                    f"round is not one the compiled schedule contains"
+                )
+            continue
         want = {
             "all_to_all": exp["a2a_operand_bytes"],
             "ppermute": exp["ppermute_round_bytes"],
@@ -573,8 +597,13 @@ def audit_workload(
     program_records = []
     legs: dict = {}
     saved = (_cfg.halo_impl, _cfg.tuned_halo_impl, _cfg.use_pallas_p2p)
+    audited_impls = [
+        impl for impl in impls
+        if impl != "sched"
+        or getattr(w.plan_np, "halo_schedule", None) is not None
+    ]
     try:
-        for impl in impls:
+        for impl in audited_impls:
             _cfg.set_flags(halo_impl=impl, tuned_halo_impl=None)
             # pinning pallas_p2p on a chip-less backend needs the explicit
             # availability opt-in (the kernels trace in interpret mode —
@@ -623,6 +652,41 @@ def audit_workload(
                     f"{want_puts}"
                 )
             continue
+        if rec["impl"] == "sched":
+            # the compiled schedule replays num_rounds ppermutes per
+            # exchange leg, and the traced per-(dtype, width) byte
+            # multiset must equal the footprint-priced rounds repeated
+            # once per leg — byte-exact, order-free
+            schedule = w.plan_np.halo_schedule
+            n_rounds = schedule.num_rounds
+            want = legs[rec["program"]] * n_rounds
+            if rec["num_ppermute"] != want:
+                failures.append(
+                    f"[{rec['program']}/{rec['impl']}] "
+                    f"{rec['num_ppermute']} ppermute rounds; expected "
+                    f"legs({legs[rec['program']]}) * "
+                    f"schedule rounds({n_rounds}) = {want}"
+                )
+                continue
+            groups: dict = {}
+            for o in rec["collective_operands"]:
+                feat = o["shape"][-1] if o["shape"] else 0
+                groups.setdefault((o["dtype"], feat), []).append(
+                    o["traced_bytes"]
+                )
+            for (dt, feat), traced in sorted(groups.items()):
+                exp = _expected_bytes(
+                    w.plan_np, dt, feat
+                )["sched_round_bytes"]
+                k, r = divmod(len(traced), max(len(exp), 1))
+                if not exp or r or sorted(traced) != sorted(exp * k):
+                    failures.append(
+                        f"[{rec['program']}/{rec['impl']}] traced round "
+                        f"bytes at ({dt}, F={feat}) "
+                        f"{sorted(traced)[:8]} != footprint rounds "
+                        f"{sorted(exp)[:8]} x {k} leg(s)"
+                    )
+            continue
         want = legs[rec["program"]] * n_deltas
         if rec["num_ppermute"] != want:
             failures.append(
@@ -637,7 +701,7 @@ def audit_workload(
         "world_size": w.world_size,
         "num_nodes": w.num_nodes,
         "num_halo_deltas": n_deltas,
-        "impls": list(impls),
+        "impls": list(audited_impls),
         "exchange_legs": legs,
         "programs": program_records,
         "donation": donation,

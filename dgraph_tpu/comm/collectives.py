@@ -56,6 +56,8 @@ def resolve_plan_impl(plan: EdgePlan, axis_name) -> str:
     impl, _ = resolve_halo_impl(
         plan.world_size, plan.halo_deltas,
         overlap_available=getattr(plan, "overlap", None) is not None,
+        sched_available=getattr(plan, "halo_schedule", None) is not None,
+        pair_rows=getattr(plan, "halo_pair_rows", ()),
     )
     return impl
 
@@ -416,6 +418,175 @@ def halo_scatter_sum_overlap(
     return unex(h, halo.send_idx, halo.send_mask)
 
 
+def _sched_rounds_fwd(x, send_idx, send_mask, axis_name, schedule, W, S):
+    """Replay a compiled :class:`~dgraph_tpu.sched.ir.HaloSchedule`:
+    per round, every rank gathers + masks the send block for its (static)
+    round peer and slices its transfer's row window; all ppermutes are
+    issued before any received block is placed (the overlap executor's
+    double-buffered shape, so XLA's scheduler can hide the wire behind
+    interleaved compute). Placement offsets come from the schedule's
+    per-rank static tables indexed by the traced ``lax.axis_index`` —
+    every rank traces the IDENTICAL program (the SPMD auditor's
+    invariant). Ranks a round's ppermute names as no-one's receiver get
+    zeros (lax.ppermute semantics), which land in a scratch tail row
+    band ``[W*S, W*S+Cmax)`` and are dropped, so the clamping semantics
+    of dynamic_update_slice never corrupt live slots. Result layout and
+    values are bit-identical to the padded all_to_all lowering: each
+    round writes rows of the masked (src -> dst) send block at the same
+    ``src*S + row`` halo-slot positions the all_to_all produces, padded
+    round rows carry the same masked values both lowerings carry, and
+    the verifier guarantees the transfers tile each live block exactly
+    once."""
+    F = x.shape[-1]
+    me = lax.axis_index(axis_name)
+    rows = schedule.round_rows()
+    c_max = max(rows)
+    sends = []
+    for k in range(schedule.num_rounds):
+        ra = schedule.rank_arrays(k)
+        dst = jnp.asarray(ra["send_dst"], jnp.int32)[me]
+        start = jnp.asarray(ra["send_start"], jnp.int32)[me]
+        idx = jnp.take(send_idx, dst, axis=0)
+        msk = jnp.take(send_mask, dst, axis=0)
+        blk = x[idx] * msk[..., None].astype(x.dtype)  # [S, F]
+        sends.append(lax.dynamic_slice(blk, (start, 0), (rows[k], F)))
+    recvs = [
+        lax.ppermute(s, axis_name, schedule.rounds[k].pairs)
+        for k, s in enumerate(sends)
+    ]
+    out = jnp.zeros((W * S + c_max, F), x.dtype)
+    for k, recv in enumerate(recvs):
+        ra = schedule.rank_arrays(k)
+        off = jnp.asarray(ra["place_off"], jnp.int32)[me]
+        out = lax.dynamic_update_slice(out, recv, (off, 0))
+    return out[: W * S]
+
+
+def _sched_rounds_rev(h, send_idx, send_mask, n_pad, axis_name, schedule,
+                      W, S):
+    """Reverse replay: per round, each fwd RECEIVER slices the cotangent
+    window its transfer landed in and ppermutes it along the reversed
+    pairs back to the fwd sender, which parks it in its ``[W+1, S, F]``
+    reduce buffer (plane = the peer it had sent to; idle ranks park the
+    zeros ppermute hands them in the scratch plane W). The buffer then
+    reduces with the SAME masked flat segment-sum the all_to_all reverse
+    runs — the mask zeroes padded round rows, so values are bit-identical
+    to it. All reverse ppermutes are issued before any placement, keeping
+    each round individually overlappable (the exact mirror of
+    :func:`_overlap_rounds_rev`)."""
+    F = h.shape[-1]
+    me = lax.axis_index(axis_name)
+    h = h.reshape(W * S, F)
+    rows = schedule.round_rows()
+    blocks = []
+    for k in range(schedule.num_rounds):
+        ra = schedule.rank_arrays(k)
+        off = jnp.asarray(ra["slice_off"], jnp.int32)[me]
+        blocks.append(lax.dynamic_slice(h, (off, 0), (rows[k], F)))
+    recvs = [
+        lax.ppermute(
+            b, axis_name,
+            [(d, s) for (s, d) in schedule.rounds[k].pairs],
+        )
+        for k, b in enumerate(blocks)
+    ]
+    back = jnp.zeros((W + 1, S, F), h.dtype)
+    for k, recv in enumerate(recvs):
+        ra = schedule.rank_arrays(k)
+        plane = jnp.asarray(ra["back_plane"], jnp.int32)[me]
+        start = jnp.asarray(ra["send_start"], jnp.int32)[me]
+        back = lax.dynamic_update_slice(back, recv[None], (plane, start, 0))
+    back = back[:W] * send_mask[..., None].astype(back.dtype)
+    flat_idx = send_idx.reshape(-1)
+    return local_ops.segment_sum(back.reshape(W * S, -1), flat_idx, n_pad)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_sched_pair(axis_name, schedule, W, S, n_pad):
+    """The compiled-schedule exchange/unexchange custom-VJP pair — the
+    exact mirror of :func:`_make_overlap_pair` with the per-delta rings
+    swapped for the compiled rounds: the exchange's backward IS the
+    reverse replay and the reverse's backward IS the forward replay,
+    pinned explicitly so the transpose keeps the round schedule (and its
+    op count, which the trace/HLO auditors pin per-round) instead of
+    whatever JAX's default transpose would serialize. Cache key includes
+    the (frozen, hashable) schedule itself, so two plans with different
+    compiled schedules never share an executor."""
+
+    @jax.custom_vjp
+    def exchange(x, send_idx, send_mask):
+        return _sched_rounds_fwd(
+            x, send_idx, send_mask, axis_name, schedule, W, S)
+
+    def ex_fwd(x, send_idx, send_mask):
+        return exchange(x, send_idx, send_mask), (send_idx, send_mask)
+
+    def ex_bwd(res, g):
+        send_idx, send_mask = res
+        dx = _sched_rounds_rev(
+            g, send_idx, send_mask, n_pad, axis_name, schedule, W, S)
+        return dx, None, None
+
+    exchange.defvjp(ex_fwd, ex_bwd)
+
+    @jax.custom_vjp
+    def unexchange(h, send_idx, send_mask):
+        return _sched_rounds_rev(
+            h, send_idx, send_mask, n_pad, axis_name, schedule, W, S)
+
+    def un_fwd(h, send_idx, send_mask):
+        return unexchange(h, send_idx, send_mask), (send_idx, send_mask)
+
+    def un_bwd(res, g):
+        send_idx, send_mask = res
+        dh = _sched_rounds_fwd(
+            g, send_idx, send_mask, axis_name, schedule, W, S)
+        return dh, None, None
+
+    unexchange.defvjp(un_fwd, un_bwd)
+    return exchange, unexchange
+
+
+@_scoped("dgraph.halo_exchange_sched")
+def halo_exchange_sched(
+    x: jax.Array,
+    halo: HaloSpec,
+    axis_name: Optional[str],
+    schedule,
+) -> jax.Array:
+    """:func:`halo_exchange` lowered as a compiled multi-round schedule
+    (:mod:`dgraph_tpu.sched`): small pairs merged into shared ppermute
+    rounds, hub pairs recursive-doubling split across rounds, rounds
+    ordered heavy-first — all decided at plan build and replayed here as
+    data. Values are bit-identical to the all_to_all lowering; the
+    custom VJP is the mirrored reverse replay."""
+    W, S = halo.send_idx.shape[0], halo.s_pad
+    if axis_name is None or schedule is None or not schedule.rounds:
+        return halo_exchange(x, halo, axis_name, deltas=(), impl="none")
+    ex, _ = _make_sched_pair(axis_name, schedule, W, S, x.shape[0])
+    return ex(x, halo.send_idx, halo.send_mask)
+
+
+@_scoped("dgraph.halo_scatter_sum_sched")
+def halo_scatter_sum_sched(
+    h: jax.Array,
+    halo: HaloSpec,
+    n_pad: int,
+    axis_name: Optional[str],
+    schedule,
+) -> jax.Array:
+    """:func:`halo_scatter_sum` lowered as the compiled schedule's
+    reverse replay (the sched pair's transpose) — same masked flat
+    segment-sum over the same buffer as the all_to_all reverse,
+    bit-identical values."""
+    W, S = halo.send_idx.shape[0], halo.s_pad
+    if axis_name is None or schedule is None or not schedule.rounds:
+        return halo_scatter_sum(h, halo, n_pad, axis_name, deltas=(),
+                                impl="none")
+    _, unex = _make_sched_pair(axis_name, schedule, W, S, n_pad)
+    return unex(h, halo.send_idx, halo.send_mask)
+
+
 @_scoped("dgraph.halo_exchange")
 def halo_exchange(
     x: jax.Array,
@@ -423,10 +594,11 @@ def halo_exchange(
     axis_name: Optional[str],
     deltas: Optional[tuple] = None,
     impl: Optional[str] = None,
+    schedule=None,
 ) -> jax.Array:
     """Exchange boundary vertex features; returns the halo buffer.
 
-    Three lowerings, same result layout and values:
+    Several lowerings, same result layout and values:
     - all_to_all (default): one padded collective; received block from peer
       p lands at rows ``[p*S, (p+1)*S)`` — exactly the plan's halo-slot
       numbering, no receive-placement pass.
@@ -436,6 +608,9 @@ def halo_exchange(
       to actual neighbors"; the NVSHMEM one-sided put analogue).
     - overlap: the double-buffered round schedule
       (:func:`halo_exchange_overlap`).
+    - sched: a compiled multi-round schedule replayed as data
+      (:func:`halo_exchange_sched`; requires ``schedule`` — the plan's
+      attached :class:`~dgraph_tpu.sched.ir.HaloSchedule`).
 
     Args:
       x: [n_pad, F] local (padded) vertex features of this shard.
@@ -446,6 +621,10 @@ def halo_exchange(
       impl: the lowering, already resolved by the CALLER (one resolution
         per call site — see :func:`resolve_plan_impl`); None resolves
         here for direct/legacy callers.
+      schedule: the plan's compiled HaloSchedule (``plan.halo_schedule``)
+        — consulted only under ``impl='sched'``, where its absence is a
+        loud error: the resolver only returns 'sched' when the plan
+        carries a schedule, so a miss here means a caller bypassed it.
     """
     F = x.shape[-1]
     W, S = halo.send_idx.shape[0], halo.s_pad
@@ -468,6 +647,14 @@ def halo_exchange(
         return halo_exchange_p2p(x, halo, axis_name, tuple(deltas))
     if impl == "overlap":
         return halo_exchange_overlap(x, halo, axis_name, tuple(deltas))
+    if impl == "sched":
+        if schedule is None:
+            raise ValueError(
+                "halo_exchange(impl='sched') needs the plan's compiled "
+                "halo schedule; resolve through resolve_plan_impl and "
+                "pass schedule=plan.halo_schedule"
+            )
+        return halo_exchange_sched(x, halo, axis_name, schedule)
     if impl == "ppermute":
         me = lax.axis_index(axis_name)
         out = jnp.zeros((W * S, F), x.dtype)
@@ -494,6 +681,7 @@ def halo_scatter_sum(
     axis_name: Optional[str],
     deltas: Optional[tuple] = None,
     impl: Optional[str] = None,
+    schedule=None,
 ) -> jax.Array:
     """Linear transpose of :func:`halo_exchange`: deliver halo-slot values
     back to their owner ranks and sum into local vertices.
@@ -521,6 +709,15 @@ def halo_scatter_sum(
         if impl == "overlap":
             return halo_scatter_sum_overlap(h, halo, n_pad, axis_name,
                                             tuple(deltas))
+        if impl == "sched":
+            if schedule is None:
+                raise ValueError(
+                    "halo_scatter_sum(impl='sched') needs the plan's "
+                    "compiled halo schedule; resolve through "
+                    "resolve_plan_impl and pass schedule=plan.halo_schedule"
+                )
+            return halo_scatter_sum_sched(h, halo, n_pad, axis_name,
+                                          schedule)
         if impl == "ppermute":
             me = lax.axis_index(axis_name)
             out = jnp.zeros((n_pad, F), h.dtype)
@@ -589,7 +786,8 @@ def halo_extend(
     if impl is None and axis_name is not None:
         impl = resolve_plan_impl(plan, axis_name)
     haloed = halo_exchange(x, plan.halo, axis_name, deltas=plan.halo_deltas,
-                           impl=impl)
+                           impl=impl,
+                           schedule=getattr(plan, "halo_schedule", None))
     return jnp.concatenate([x, haloed], axis=0)
 
 
@@ -705,7 +903,7 @@ def scatter_sum(
     remote_part = full[n_pad:]
     return local_part + halo_scatter_sum(
         remote_part, plan.halo, n_pad, axis_name, deltas=plan.halo_deltas,
-        impl=impl,
+        impl=impl, schedule=getattr(plan, "halo_schedule", None),
     )
 
 

@@ -145,11 +145,14 @@ gather_col_block: int = int(os.environ.get("DGRAPH_TPU_GATHER_COL_BLOCK", "128")
 # active peer-delta set is sparse, else one padded all_to_all; 'overlap'
 # — interior/boundary split with the boundary rounds hidden behind
 # interior aggregation — whenever the plan carries its OverlapSpec),
-# 'all_to_all', 'ppermute', 'overlap', or 'pallas_p2p' (device-initiated
+# 'all_to_all', 'ppermute', 'overlap', 'pallas_p2p' (device-initiated
 # one-sided puts fused into the Pallas kernel; needs the overlap split
-# AND pallas_p2p_available()). Resolution precedence lives in
+# AND pallas_p2p_available()), or 'sched' (a compiled multi-round
+# schedule — dgraph_tpu.sched — replayed as data; needs the plan's
+# attached halo_schedule). Resolution precedence lives in
 # plan.resolve_halo_impl: this env pin > the adopted tuning record
-# (tuned_halo_impl below) > the cost-model heuristic.
+# (tuned_halo_impl below) > the cost-model heuristic (which never picks
+# pallas_p2p or sched on its own).
 halo_impl: str = os.environ.get("DGRAPH_TPU_HALO_IMPL", "auto")
 
 # Edge-axis chunk count for the overlap lowering's interior aggregation

@@ -85,6 +85,7 @@ ENTRY_KINDS = (
     "hlo_drift",         # fallback tier 3: lowered-vs-footprint bytes
     "spmd_drift",        # fallback tier 4: cross-rank schedule identity
     "tune_record",       # tune_<sig>.json TuningRecord
+    "sched_compile",     # compiled halo schedule: id, rounds, priced bytes
     "serve_health",      # serving latency/recompile/tenant record
     "supervise_lineage",        # single-child restart lineage
     "supervise_group_lineage",  # multi-rank group lineage
@@ -493,6 +494,34 @@ def _norm_lineage(obj: dict, source: str, round_n=None, git_rev=None) -> tuple:
     )], []
 
 
+def _norm_sched_compile(obj: dict, source: str) -> tuple:
+    """sched_compile: one compiled halo schedule (dgraph_tpu.sched) with
+    its footprint pricing. The ``_bytes``/``_count`` metric suffixes put
+    the compiled shape under obs.regress's byte-exact zero-tolerance
+    class: a commit that silently changes what the compiler emits for
+    the same workload goes RED, while ``exposed_us`` rides the
+    noise-aware timing gate. The schedule_id in meta names the exact
+    round order (content hash of the serialized IR)."""
+    metrics = {
+        "rounds_count": obj.get("rounds"),
+        "transfers_count": obj.get("transfers"),
+        "operand_bytes": obj.get("operand_bytes_per_shard"),
+        "exposed_us": obj.get("exposed_us"),
+    }
+    rb = obj.get("round_bytes_per_shard")
+    if isinstance(rb, (list, tuple)):
+        metrics["max_round_bytes"] = max(rb, default=0)
+    return [_entry(
+        "sched_compile", metrics,
+        workload=_workload_tag(obj.get("workload")),
+        halo_impl="sched",
+        git_rev=obj.get("git_rev"), recorded_at=obj.get("recorded_at"),
+        source=source, round_n=obj.get("round"),
+        meta={"schedule_id": obj.get("schedule_id"),
+              "round_rows": list(obj.get("round_rows") or [])[:64]},
+    )], []
+
+
 def _norm_run_health(obj: dict, source: str) -> tuple:
     metrics = {"wall_s": obj.get("wall_s"),
                "n_probes": len(obj.get("probes") or [])}
@@ -552,6 +581,8 @@ def normalize_record(obj, source: str = "") -> tuple:
             return _norm_lineage(obj, source)
         if kind == "run_health":
             return _norm_run_health(obj, source)
+        if kind == "sched_compile":
+            return _norm_sched_compile(obj, source)
         if kind == "tune_record" or (
             kind is None and "record_id" in obj and "signature" in obj
             and "cost" in obj

@@ -300,10 +300,30 @@ def _fx_serve(i: int, *, p99: float = 50.0) -> dict:
     }
 
 
+def _fx_sched(i: int, *, operand_bytes: int = 2048, rounds: int = 3) -> dict:
+    """One compiled-schedule record (dgraph_tpu.sched -> obs.ledger
+    ``sched_compile``). Shape metrics carry the exact-class suffixes, so
+    the mutant's +64 bytes must go RED with zero tolerance."""
+    jitter = [0.0, 0.4, -0.2, 0.1, 0.3, -0.1, 0.2][i % 7]
+    return {
+        "kind": "sched_compile",
+        "workload": {"world_size": 2, "nodes": 96, "edges": 400,
+                     "feat_dim": 8, "seed": 0},
+        "schedule_id": "fixture0sched",
+        "rounds": rounds, "transfers": 4,
+        "operand_bytes_per_shard": operand_bytes,
+        "round_rows": [64, 32, 32],
+        "exposed_us": 12.0 + jitter,
+        "git_rev": f"rev{i:04d}",
+        "recorded_at": f"2026-08-01T02:{i:02d}:00Z",
+    }
+
+
 def _seed(tmp: str, n: int = 6) -> None:
     for i in range(n):
         ingest(_fx_round(i), f"fixture_r{i:02d}", tmp)
         ingest(_fx_serve(i), f"fixture_serve_r{i:02d}", tmp)
+        ingest(_fx_sched(i), f"fixture_sched_r{i:02d}", tmp)
 
 
 def _selftest() -> dict:
@@ -357,6 +377,14 @@ def _selftest() -> dict:
             lambda tmp: ingest(_fx_round(6, include_hlo=False),
                                "fixture_r06", tmp),
             "fallback_tiers",
+        ),
+        # 5. drifted compiled schedule: +64 operand bytes for the same
+        # workload — a compiler change altering the emitted schedule must
+        # hit the byte-exact class, not a percentage gate
+        "drifted_schedule": (
+            lambda tmp: ingest(_fx_sched(6, operand_bytes=2048 + 64),
+                               "fixture_sched_r06", tmp),
+            "operand_bytes",
         ),
     }
     for name, (mutate, expect_metric) in mutants.items():

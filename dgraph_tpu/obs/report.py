@@ -197,7 +197,8 @@ def _selftest() -> dict:
         entries, _ = read_ledger(tmp)
         md = render_trajectory(entries, directory=tmp)
         for want in ("## Bench rounds", "## cpu_scan_delta",
-                     "## serve_health", "exchange_ms", "p99_ms", "450."):
+                     "## serve_health", "## sched_compile",
+                     "operand_bytes", "exchange_ms", "p99_ms", "450."):
             check(want in md, f"rendered trajectory lacks {want!r}")
     return {"kind": "report_selftest", "failures": failures,
             "ok": not failures}

@@ -357,6 +357,8 @@ class OverlapSpec:
         "halo_deltas",
         "halo_sort_mc",
         "gather_mv",
+        "halo_pair_rows",
+        "halo_schedule",
     )
 )
 class EdgePlan:
@@ -434,6 +436,20 @@ class EdgePlan:
     # resolved halo lowering asks for it (env pin / adopted tuning record
     # — see resolve_halo_impl); costs ~2x the plan's per-edge index bytes.
     overlap: Any = None
+    # Static [W][W] traffic matrix: deduped live halo rows per
+    # (sender, needer) pair — halo_counts as plain nested int tuples, so
+    # it survives plan pickling/sharding and rides the jit cache key.
+    # Feeds the row-weighted pick_halo_impl heuristic and the schedule
+    # compiler (dgraph_tpu.sched). () on plans predating the compiler
+    # (stale caches rebuild via PLAN_FORMAT_VERSION).
+    halo_pair_rows: tuple = ()
+    # Compiled multi-round halo schedule (dgraph_tpu.sched.ir.
+    # HaloSchedule — frozen/hashable, so static aux is safe), attached
+    # deterministically at plan build whenever halo_pair_rows is live.
+    # Replayed by comm.collectives' round executor under
+    # halo_impl="sched"; None when no cross-rank traffic (or on plans
+    # predating the compiler).
+    halo_schedule: Any = None
 
     def ids_sorted(self, side: str) -> bool:
         """True iff this side's per-edge index is monotone: the OWNER side
@@ -522,7 +538,9 @@ def interior_boundary_edge_counts(plan: EdgePlan) -> dict:
     }
 
 
-def pick_halo_impl(world_size: int, halo_deltas: tuple) -> str:
+def pick_halo_impl(
+    world_size: int, halo_deltas: tuple, pair_rows: tuple = (),
+) -> str:
     """The heuristic halo-exchange lowering from the plan's active peer set.
 
     Cost model: one padded ``all_to_all`` moves ``(W-1) * s_pad`` remote rows
@@ -533,24 +551,65 @@ def pick_halo_impl(world_size: int, halo_deltas: tuple) -> str:
     actual neighbors"); the crossover is ~W/2 live deltas.
     Returns 'none' | 'ppermute' | 'all_to_all'.
 
+    ``pair_rows`` (the plan's static ``[W][W]`` live-row traffic matrix,
+    ``plan.halo_pair_rows``) weights the delta count by actual traffic:
+    the EFFECTIVE round count is how many max-pair-sized rounds the total
+    traffic fills, ``ceil(total_rows / max_pair_rows)``, capped by the
+    ring count. A single giant delta among near-empty ones used to read
+    as "many deltas -> all_to_all" even though one ring carries ~all the
+    bytes; weighted, it reads as ~1 effective round -> ppermute. A
+    uniform matrix (and the no-matrix legacy case) reduces exactly to the
+    old ``len(halo_deltas)`` rule.
+
     This is the FALLBACK tier only: runtime call sites resolve through
     :func:`resolve_halo_impl`, which lets an env pin or an adopted tuning
     record override the heuristic.
     """
     if not halo_deltas:
         return "none"
-    return "ppermute" if len(halo_deltas) <= max(1, world_size // 2) else "all_to_all"
+    n_eff = len(halo_deltas)
+    if pair_rows:
+        live = [int(v) for row in pair_rows for v in row if int(v) > 0]
+        if live:
+            n_eff = min(n_eff, -(-sum(live) // max(live)))  # ceil div
+    return "ppermute" if n_eff <= max(1, world_size // 2) else "all_to_all"
+
+
+def compile_plan_schedule(
+    pair_rows: tuple, *, s_pad: int, world_size: int, halo_deltas: tuple,
+):
+    """The ONE attach rule for a plan's compiled halo schedule: both
+    plan-build paths (:func:`_finalize_plan`) and the shard assembler
+    (:func:`assemble_plan`) compile through here, so a monolithic build
+    and a cache/shard round-trip of the same graph carry byte-identical
+    schedules (same ``schedule_id``) — and, because ``pair_rows`` is
+    always the FULL-WORLD static matrix (rank-subset loads keep whole-
+    world statics), every rank holds the identical round order by
+    construction: the rank-divergence/deadlock class the SPMD
+    issue-sequence auditor proves absent. Returns ``None`` when there is
+    no cross-rank traffic (or no matrix: plans predating the compiler).
+    """
+    if not halo_deltas or not pair_rows:
+        return None
+    if not any(v for row in pair_rows for v in row):
+        return None
+    from dgraph_tpu.sched.passes import compile_halo_schedule
+
+    return compile_halo_schedule(
+        pair_rows, s_pad=int(s_pad), world_size=int(world_size)
+    )
 
 
 def resolve_halo_impl(
     world_size: int, halo_deltas: tuple, *, overlap_available: bool = False,
-    p2p_available: "bool | None" = None,
+    p2p_available: "bool | None" = None, sched_available: bool = False,
+    pair_rows: tuple = (),
 ) -> tuple[str, str]:
     """The halo lowering the run will actually execute, plus who decided.
 
     Returns ``(impl, source)`` with impl one of ``'none'``,
-    ``'all_to_all'``, ``'ppermute'``, ``'overlap'``, ``'pallas_p2p'`` and
-    source one of:
+    ``'all_to_all'``, ``'ppermute'``, ``'overlap'``, ``'pallas_p2p'``,
+    ``'sched'`` and source one of:
 
     - ``'env'``       — ``DGRAPH_TPU_HALO_IMPL`` (or ``config.set_flags``)
       pins the lowering; the operator's word is final.
@@ -584,6 +643,20 @@ def resolve_halo_impl(
     un-A/B'd kernel engages only through an explicit pin or a persisted
     tuning record (the ``use_pallas_gather`` precedent).
 
+    ``'sched'`` (the compiled multi-round schedule,
+    :mod:`dgraph_tpu.sched`, replayed by ``comm.collectives``'s round
+    executor) follows the same discipline: it is legal only when the
+    plan actually carries a compiled schedule (``sched_available``,
+    i.e. ``plan.halo_schedule is not None``) — a pin or record naming it
+    on a schedule-less plan degrades with a one-time warning to the next
+    tier — and the heuristic tier never picks it on its own: a compiled
+    schedule engages only through an explicit pin or a persisted tuning
+    record that A/B'd it against the fixed lowerings.
+
+    ``pair_rows`` (``plan.halo_pair_rows``) is forwarded to
+    :func:`pick_halo_impl` so the heuristic tier weighs actual per-pair
+    traffic, not just the ring count.
+
     Every consumer of the decision (``comm.collectives``'s runtime dispatch,
     ``obs.footprint``'s byte accounting, :func:`plan_efficiency`'s report)
     resolves through here, so what runs, what is accounted, and what is
@@ -601,7 +674,9 @@ def resolve_halo_impl(
             return p2p_available
         return _cfg.pallas_p2p_available()
 
-    legal = ("all_to_all", "ppermute") + (("overlap",) if overlap_available else ())
+    legal = ("all_to_all", "ppermute") + (
+        ("overlap",) if overlap_available else ()
+    ) + (("sched",) if sched_available else ())
     for impl, source in (
         (_cfg.halo_impl, "env"),
         (_cfg.tuned_halo_impl, "record"),
@@ -610,13 +685,15 @@ def resolve_halo_impl(
             return impl, source
         if impl == "overlap":  # pinned but the plan carries no split
             _warn_overlap_unavailable(source)
+        if impl == "sched":  # pinned but the plan carries no schedule
+            _warn_sched_unavailable(source)
         if impl == "pallas_p2p":
             if _p2p_ok():
                 return impl, source
             _warn_p2p_unavailable(source, overlap_available)
     if overlap_available:
         return "overlap", "heuristic"
-    return pick_halo_impl(world_size, halo_deltas), "heuristic"
+    return pick_halo_impl(world_size, halo_deltas, pair_rows), "heuristic"
 
 
 def resolve_overlap_intent() -> bool:
@@ -645,6 +722,20 @@ def _warn_overlap_unavailable(source: str) -> None:
             "halo_impl='overlap' requested by %s but the plan carries no "
             "interior/boundary split (built without overlap=True); the "
             "next resolution tier decides the lowering instead", source,
+        )
+
+
+_sched_warned: set = set()
+
+
+def _warn_sched_unavailable(source: str) -> None:
+    if source not in _sched_warned:
+        _sched_warned.add(source)
+        _logger.warning(
+            "halo_impl='sched' requested by %s but the plan carries no "
+            "compiled halo schedule (halo_schedule is None — plan predates "
+            "the schedule compiler or has no cross-rank traffic); the next "
+            "resolution tier decides the lowering instead", source,
         )
 
 
@@ -690,7 +781,9 @@ def plan_efficiency(plan: EdgePlan, layout: EdgePlanLayout) -> dict:
     src_total = int(layout.src_counts.sum())
     dst_total = int(layout.dst_counts.sum())
     impl, impl_source = resolve_halo_impl(
-        W, plan.halo_deltas, overlap_available=plan.overlap is not None
+        W, plan.halo_deltas, overlap_available=plan.overlap is not None,
+        sched_available=plan.halo_schedule is not None,
+        pair_rows=plan.halo_pair_rows,
     )
     return {
         "edge_fill": real_edges / max(W * E, 1),
@@ -817,7 +910,9 @@ def validate_plan(plan: EdgePlan) -> None:
     if errors:
         raise ValueError("invalid EdgePlan: " + "; ".join(errors))
     impl, impl_source = resolve_halo_impl(
-        W, plan.halo_deltas, overlap_available=plan.overlap is not None
+        W, plan.halo_deltas, overlap_available=plan.overlap is not None,
+        sched_available=plan.halo_schedule is not None,
+        pair_rows=plan.halo_pair_rows,
     )
     _logger.info(
         "validate_plan OK: W=%d e_pad=%d s_pad=%d; halo lowering=%s "
@@ -1313,6 +1408,14 @@ def _finalize_plan(
             owner_sorted, scatter_block_e, scatter_block_n,
         )
 
+    halo_pair_rows = tuple(
+        tuple(int(v) for v in row) for row in np.asarray(halo_counts)
+    )
+    halo_schedule = compile_plan_schedule(
+        halo_pair_rows, s_pad=s_pad_val, world_size=W,
+        halo_deltas=halo_deltas,
+    )
+
     plan = EdgePlan(
         src_index=src_idx_arr,
         dst_index=dst_idx_arr,
@@ -1337,6 +1440,8 @@ def _finalize_plan(
         halo_sort_mc=halo_sort_mc,
         gather_mv=gather_mv,
         overlap=overlap_spec,
+        halo_pair_rows=halo_pair_rows,
+        halo_schedule=halo_schedule,
     )
     layout = EdgePlanLayout(
         edge_rank=edge_rank,
@@ -1569,6 +1674,11 @@ def _shard_statics(prep, *, homogeneous, edge_owner, sort_edges, sort_route,
         "scatter_block_e": SCATTER_BLOCK_E,
         "scatter_block_n": SCATTER_BLOCK_N,
         "halo_deltas": [int(d) for d in prep.halo_deltas],
+        # full-world traffic matrix: rank-subset loads keep whole-world
+        # statics, so every host compiles the identical halo schedule
+        "halo_pair_rows": [
+            [int(v) for v in row] for row in np.asarray(prep.halo_counts)
+        ],
     }
     if overlap:
         # subset pads are global maxima over ranks — computable from the
@@ -1923,6 +2033,9 @@ def assemble_plan(manifest: dict, payloads: dict, ranks: list) -> EdgePlan:
         return np.asarray([payloads[r][key] for r in ranks], np.int32)
 
     sort_route = st.get("sort_route", False)
+    pair_rows = tuple(
+        tuple(int(v) for v in row) for row in st.get("halo_pair_rows", [])
+    )
     overlap_spec = None
     if st.get("overlap"):
         def ostack(key):
@@ -1970,6 +2083,12 @@ def assemble_plan(manifest: dict, payloads: dict, ranks: list) -> EdgePlan:
         halo_sort_mc=int(st.get("halo_sort_mc", 1)),
         gather_mv=int(st.get("gather_mv", 0)),
         overlap=overlap_spec,
+        halo_pair_rows=pair_rows,
+        halo_schedule=compile_plan_schedule(
+            pair_rows, s_pad=int(st["s_pad"]),
+            world_size=int(st["world_size"]),
+            halo_deltas=tuple(int(d) for d in st["halo_deltas"]),
+        ),
     )
 
 

@@ -1,0 +1,38 @@
+"""Halo schedule compiler: EdgePlan traffic matrix -> verified
+multi-round collective schedules (ROADMAP item 2, a GC3 for the halo).
+
+jax-free by the lint-enforced contract — the IR and passes import
+cleanly where jax is absent; the jax-side round executor lives in
+:mod:`dgraph_tpu.comm.collectives` and replays the schedule under
+``halo_impl="sched"``.
+"""
+
+from dgraph_tpu.sched.ir import (
+    SCHED_IR_VERSION,
+    HaloSchedule,
+    Round,
+    Transfer,
+    normalize_pair_rows,
+    verify_schedule,
+)
+from dgraph_tpu.sched.passes import (
+    compile_halo_schedule,
+    default_split_threshold,
+    normalize_transfers,
+    pack_rounds,
+    split_transfers,
+)
+
+__all__ = [
+    "SCHED_IR_VERSION",
+    "HaloSchedule",
+    "Round",
+    "Transfer",
+    "compile_halo_schedule",
+    "default_split_threshold",
+    "normalize_pair_rows",
+    "normalize_transfers",
+    "pack_rounds",
+    "split_transfers",
+    "verify_schedule",
+]

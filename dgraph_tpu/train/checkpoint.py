@@ -222,7 +222,10 @@ def checkpoint_keys(ckpt_dir: str, step: Optional[int] = None):
 # Bump whenever EdgePlan's fields/defaults change shape or meaning: stale
 # cache pickles must REBUILD, not silently inherit new class defaults for
 # fields they were never built with (e.g. scatter_block_e).
-PLAN_FORMAT_VERSION = 8  # v8: sharded plan artifacts — per-rank
+PLAN_FORMAT_VERSION = 9  # v9: halo_pair_rows traffic matrix + compiled
+# halo_schedule statics (dgraph_tpu.sched) — cached plans predating the
+# schedule compiler must rebuild so the matrix lands in the manifest;
+# v8: sharded plan artifacts — per-rank
 # shard_XXXX.pkl files under plan_<key>/ with a checksummed manifest.json
 # (dgraph_tpu.plan_shards), streamed by plan.build_edge_plan_sharded,
 # loaded/repaired shard-by-shard here; the monolithic plan_<key>.pkl is

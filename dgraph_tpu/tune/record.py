@@ -119,7 +119,8 @@ class TuningRecord:
                 errors.append(f"pad_multiple {pm!r} not a positive int")
             impl = self.config.get("halo_impl")
             if impl is not None and impl not in (
-                "none", "ppermute", "all_to_all", "overlap", "pallas_p2p"
+                "none", "ppermute", "all_to_all", "overlap", "pallas_p2p",
+                "sched",
             ):
                 errors.append(f"halo_impl {impl!r} unknown")
             serve = self.config.get("serve")
@@ -254,7 +255,7 @@ def adopt_record(rec: TuningRecord) -> dict:
     impl = rec.config.get("halo_impl")
     _cfg.set_flags(
         tuned_halo_impl=impl
-        if impl in ("ppermute", "all_to_all", "overlap", "pallas_p2p")
+        if impl in ("ppermute", "all_to_all", "overlap", "pallas_p2p", "sched")
         else None
     )
     _cfg.set_flags(tuning_record_id=rec.record_id)

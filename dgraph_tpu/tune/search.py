@@ -122,6 +122,25 @@ def candidate_cost(
         p2p_hbm_us = (2 * n_d + W) * S * row / (hbm_gbps * 1e3)
         p2p_exposed = max(max(p2p_wire_us, p2p_hbm_us) - interior_leg_us, 0.0)
 
+    # the compiled schedule enters the ranking only when the plan carries
+    # one (plan.halo_schedule attached at build) — ranked from the SAME
+    # launch-aware bound family as the fixed lowerings: per-round compiled
+    # operand bytes on the wire + one launch per round, the staged blocks'
+    # HBM streams, minus the same interior absorption the overlap rounds
+    # get (the sched executor has the identical issue-all-then-place shape)
+    sched_fp = fp["collectives"]["halo_exchange"].get("sched")
+    sched_rankable = bool(n_d) and sched_fp is not None
+    sched_exposed = 0.0
+    if sched_rankable:
+        n_r = sched_fp["rounds"]
+        sched_wire_us = (
+            wire.get("sched", 0) / (ici_gbps * 1e3) + n_r * LAUNCH_US
+        )
+        sched_hbm_us = (2 * n_r + W) * S * row / (hbm_gbps * 1e3)
+        sched_exposed = max(
+            max(sched_wire_us, sched_hbm_us) - interior_leg_us, 0.0
+        )
+
     # the pallas_p2p knob only enters the ranking where it can actually
     # lower (TPU backend, or the explicit interpret opt-in) — a record
     # should not persist a winner the run would degrade away from
@@ -139,13 +158,15 @@ def candidate_cost(
         }
         if p2p_rankable:
             bounds["pallas_p2p"] = p2p_exposed
+        if sched_rankable:
+            bounds["sched"] = sched_exposed
         # stable tie-break preserving the pre-overlap semantics: ppermute
         # beats all_to_all on equal cost (as before), overlap — equal to
         # ppermute exactly when there is no interior work to hide behind
-        # — only wins when it actually hides something, and pallas_p2p
-        # (last) only when its one-launch fused transport strictly beats
-        # the overlap schedule
-        order = ("ppermute", "all_to_all", "overlap", "pallas_p2p")
+        # — only wins when it actually hides something, and pallas_p2p /
+        # sched (last) only when they strictly beat the fixed lowerings:
+        # an un-A/B'd transport or compiled schedule never wins a tie
+        order = ("ppermute", "all_to_all", "overlap", "pallas_p2p", "sched")
         impl = min(
             (k for k in order if k in bounds),
             key=lambda k: (bounds[k], order.index(k)),
@@ -168,6 +189,15 @@ def candidate_cost(
         # cannot lower); ranked only when pallas_p2p_rankable
         "pallas_p2p_exposed_us": round(p2p_exposed, 3),
         "pallas_p2p_rankable": p2p_rankable,
+        # compiled-schedule pricing: always reported when a schedule is
+        # attached (auditable), ranked only via sched_rankable
+        "sched_exposed_us": round(sched_exposed, 3),
+        "sched_rankable": sched_rankable,
+        "sched_rounds": int(sched_fp["rounds"]) if sched_fp else 0,
+        "sched_schedule_id": sched_fp["schedule_id"] if sched_fp else None,
+        "sched_operand_bytes": (
+            int(sched_fp["operand_bytes_per_shard"]) if sched_fp else 0
+        ),
         "interior_frac": split["interior_frac"],
         "boundary_frac": split["boundary_frac"],
         "wire_efficiency": fp["collectives"]["halo_exchange"]["wire_efficiency"],
@@ -427,6 +457,29 @@ def search(
         phase="result", record_id=record.record_id, winner=winner_cand.key,
         **cost,
     )
+    if winner_cost.get("sched_schedule_id"):
+        # the winner's compiled halo schedule joins the perf ledger: its
+        # _bytes/_count metrics land in regress's byte-exact class, so a
+        # compiler change that alters what this workload's schedule looks
+        # like goes RED across commits (off unless DGRAPH_LEDGER_DIR set;
+        # maybe_ingest swallows every failure)
+        from dgraph_tpu.obs.ledger import maybe_ingest
+
+        maybe_ingest(
+            {
+                "kind": "sched_compile",
+                "workload": {
+                    "world_size": world_size, "nodes": num_nodes,
+                    "edges": int(edge_index.shape[1]),
+                    "feat_dim": feat_dim,
+                },
+                "schedule_id": winner_cost["sched_schedule_id"],
+                "rounds": winner_cost["sched_rounds"],
+                "operand_bytes_per_shard": winner_cost["sched_operand_bytes"],
+                "exposed_us": winner_cost["sched_exposed_us"],
+            },
+            source="tune.search", default_on=False,
+        )
     _logger.info(
         "tuning search done: winner=%s (%s us/layer vs default %s), phase=%s",
         winner_cand.key, winner_cost["total_us"], default_cost["total_us"],

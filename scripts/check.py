@@ -70,6 +70,12 @@ PASSES = [
     ("spmd-selftest",
      [sys.executable, "-m", "dgraph_tpu.analysis.spmd",
       "--selftest", "true"]),
+    # halo schedule compiler: IR round-trip identity, pass-pipeline
+    # invariants (conflict-freedom, exact coverage, split/pack bounds),
+    # and the vacuity mutants (a conflicting round and a dropped
+    # transfer must each go RED) — pure stdlib, zero XLA compiles
+    ("sched-selftest",
+     [sys.executable, "-m", "dgraph_tpu.sched", "--selftest", "true"]),
     # perf-trajectory drift sentinel: the four seeded-drift vacuity
     # mutants (inflated wire bytes, slowed scan-delta, fattened p99,
     # dropped fallback tier) must each go RED and the clean fixture

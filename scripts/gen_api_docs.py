@@ -41,6 +41,7 @@ SECTIONS = [
     ("Sharded plan builds (cache format v8)", "dgraph_tpu.plan",
      ["build_plan_shards", "build_edge_plan_sharded", "load_sharded_plan",
       "assemble_plan", "shard_nbytes_estimate", "reshard_vertex_data"]),
+    ("Halo schedule compiler", "dgraph_tpu.sched", None),
     ("Plan shard IO & integrity", "dgraph_tpu.plan_shards",
      ["PlanShardWriter", "PlanManifestError", "PlanShardError",
       "PlanBuildMemoryExceeded", "read_manifest", "write_manifest",

@@ -1,0 +1,348 @@
+"""Compiled halo schedules (dgraph_tpu.sched): compiler/IR invariants,
+plan attachment, the resolver ladder's 'sched' row, footprint/trace byte
+equality, and bit-identical execution vs the all_to_all lowering on 2-
+and 4-shard graphs."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dgraph_tpu import config as cfg
+from dgraph_tpu import plan as pl
+from dgraph_tpu.comm import collectives
+from dgraph_tpu.comm.mesh import make_graph_mesh
+from dgraph_tpu.plan import shard_edge_data, shard_vertex_data, unshard_vertex_data
+from dgraph_tpu.sched import compile_halo_schedule, verify_schedule
+from dgraph_tpu.sched.ir import HaloSchedule
+from dgraph_tpu.testing import (
+    dense_gather,
+    dense_scatter_sum,
+    spmd_apply,
+    unshard_edge_data,
+)
+
+
+def _graph(rng, W, V=96, E=600):
+    edges = rng.integers(0, V, size=(2, E))
+    part = np.sort(rng.integers(0, W, V)).astype(np.int32)
+    return edges, part
+
+
+# ---------------------------------------------------------------------------
+# compiler + plan attachment (host-only, zero compiles)
+# ---------------------------------------------------------------------------
+
+
+def test_plan_attaches_verified_schedule(rng):
+    W = 4
+    edges, part = _graph(rng, W)
+    plan, _ = pl.build_edge_plan(edges, part, world_size=W)
+    assert plan.halo_pair_rows, "traffic matrix missing from the plan"
+    sched = plan.halo_schedule
+    assert isinstance(sched, HaloSchedule)
+    assert verify_schedule(sched, plan.halo_pair_rows) == []
+    # deterministic: an identical build compiles the identical schedule
+    plan2, _ = pl.build_edge_plan(edges, part, world_size=W)
+    assert plan2.halo_schedule.schedule_id == sched.schedule_id
+
+
+def test_schedule_roundtrip_identity(rng):
+    W = 4
+    edges, part = _graph(rng, W)
+    plan, _ = pl.build_edge_plan(edges, part, world_size=W)
+    sched = plan.halo_schedule
+    back = HaloSchedule.from_dict(sched.to_dict())
+    assert back == sched
+    assert back.schedule_id == sched.schedule_id
+
+
+def test_assembled_plan_carries_identical_schedule(rng, tmp_path):
+    # the sharded-artifact path must compile the SAME schedule the
+    # monolithic build attached (rank-identical statics: deadlock class)
+    from dgraph_tpu.plan import build_plan_shards, load_sharded_plan
+
+    W = 4
+    edges, part = _graph(rng, W)
+    plan, _ = pl.build_edge_plan(edges, part, world_size=W)
+    build_plan_shards(
+        edges, part, out_dir=str(tmp_path), world_size=W, write_layout=False
+    )
+    for r in range(W):
+        sub, _ = load_sharded_plan(str(tmp_path), ranks=[r], load_layout=False)
+        assert sub.halo_pair_rows == plan.halo_pair_rows
+        assert sub.halo_schedule.schedule_id == plan.halo_schedule.schedule_id
+
+
+def test_sched_selftest_green():
+    from dgraph_tpu.sched.__main__ import _selftest
+
+    out = _selftest()
+    assert out["ok"], out["failures"]
+
+
+def test_large_pairs_split_into_rounds():
+    # one hub pair 64 rows + small peers: recursive doubling must chop
+    # the hub so no single round is the whole transfer
+    pair_rows = (
+        (0, 64, 1, 1),
+        (1, 0, 1, 0),
+        (1, 1, 0, 0),
+        (1, 0, 1, 0),
+    )
+    sched = compile_halo_schedule(pair_rows, s_pad=64, world_size=4)
+    assert verify_schedule(sched, pair_rows) == []
+    hub = [
+        t for rnd in sched.rounds for t in rnd.transfers
+        if t.src == 0 and t.dst == 1
+    ]
+    assert len(hub) > 1, "64-row hub pair was never split"
+    assert max(r.row_count for r in sched.rounds) < 64
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: the heuristic weighs per-delta row counts, not delta count
+# ---------------------------------------------------------------------------
+
+
+def test_pick_halo_impl_weighs_row_counts():
+    W = 8
+    deltas = (1, 2, 3, 4, 5)  # 5 > W//2: the old count-only rule says a2a
+    assert pl.pick_halo_impl(W, deltas) == "all_to_all"
+    # skewed matrix: one pair carries ~all rows -> effectively ONE round
+    # of traffic; the weighted rule must pick ppermute
+    skewed = tuple(
+        tuple(100 if (i, j) == (0, 1) else (1 if i != j else 0)
+              for j in range(W))
+        for i in range(W)
+    )
+    assert pl.pick_halo_impl(W, deltas, skewed) == "ppermute"
+    # uniform matrix reduces to the old rule
+    uniform = tuple(
+        tuple(0 if i == j else 5 for j in range(W)) for i in range(W)
+    )
+    assert pl.pick_halo_impl(W, deltas, uniform) == "all_to_all"
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: the resolver ladder's 'sched' row
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def ladder():
+    saved = (cfg.halo_impl, cfg.tuned_halo_impl)
+    pl._sched_warned.clear()
+    yield
+    cfg.set_flags(halo_impl=saved[0], tuned_halo_impl=saved[1])
+    pl._sched_warned.clear()
+
+
+def test_env_pin_selects_sched(ladder):
+    cfg.set_flags(halo_impl="sched", tuned_halo_impl=None)
+    assert pl.resolve_halo_impl(4, (1, 2), sched_available=True) == (
+        "sched", "env",
+    )
+
+
+def test_env_pin_beats_tuned_record(ladder):
+    cfg.set_flags(halo_impl="sched", tuned_halo_impl="all_to_all")
+    assert pl.resolve_halo_impl(4, (1, 2), sched_available=True) == (
+        "sched", "env",
+    )
+    cfg.set_flags(halo_impl="all_to_all", tuned_halo_impl="sched")
+    assert pl.resolve_halo_impl(4, (1, 2), sched_available=True) == (
+        "all_to_all", "env",
+    )
+
+
+def test_tuned_record_selects_sched(ladder):
+    cfg.set_flags(halo_impl="auto", tuned_halo_impl="sched")
+    assert pl.resolve_halo_impl(4, (1, 2), sched_available=True) == (
+        "sched", "record",
+    )
+
+
+def test_pin_degrades_with_one_warning_when_no_schedule(ladder, caplog):
+    cfg.set_flags(halo_impl="sched", tuned_halo_impl=None)
+    with caplog.at_level(logging.WARNING, logger="dgraph_tpu.plan"):
+        impl, source = pl.resolve_halo_impl(4, (1, 2), sched_available=False)
+        assert impl != "sched" and source == "heuristic"
+        warned = [r for r in caplog.records if "sched" in r.getMessage()]
+        assert len(warned) == 1, "pinned-but-unavailable sched must warn"
+        # second resolution: same degrade, NO second warning
+        impl2, _ = pl.resolve_halo_impl(4, (1, 2), sched_available=False)
+        assert impl2 == impl
+        warned = [r for r in caplog.records if "sched" in r.getMessage()]
+        assert len(warned) == 1, "degrade warning must fire once per source"
+
+
+def test_heuristic_never_picks_sched(ladder):
+    cfg.set_flags(halo_impl="auto", tuned_halo_impl=None)
+    for deltas in ((1,), (1, 2), tuple(range(1, 8))):
+        impl, source = pl.resolve_halo_impl(8, deltas, sched_available=True)
+        assert source == "heuristic"
+        assert impl != "sched", "un-A/B'd sched auto-picked by heuristic"
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: the sched_compile ledger record kind
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_ingests_sched_compile(tmp_path):
+    from dgraph_tpu.obs.ledger import ingest, read_ledger
+
+    obj = {
+        "kind": "sched_compile",
+        "workload": {"world_size": 4, "nodes": 96, "feat_dim": 8},
+        "schedule_id": "abc123def456",
+        "rounds": 3, "transfers": 5,
+        "operand_bytes_per_shard": 4096,
+        "round_rows": [64, 32, 32],
+        "exposed_us": 7.5,
+    }
+    assert ingest(obj, "test", str(tmp_path))["appended"] == 1
+    entries, _ = read_ledger(str(tmp_path))
+    (e,) = [x for x in entries if x["kind"] == "sched_compile"]
+    assert e["metrics"]["rounds_count"] == 3
+    assert e["metrics"]["transfers_count"] == 5
+    assert e["metrics"]["operand_bytes"] == 4096
+    assert e["meta"]["schedule_id"] == "abc123def456"
+    assert e["halo_impl"] == "sched"
+    # idempotent by entry id
+    again = ingest(obj, "test", str(tmp_path))
+    assert again["appended"] == 0 and again["deduped"] == 1
+
+
+# ---------------------------------------------------------------------------
+# footprint pricing == traced operand bytes, per round (zero compiles)
+# ---------------------------------------------------------------------------
+
+
+def test_footprint_prices_traced_rounds(rng):
+    from dgraph_tpu.analysis.trace import collect_collectives
+    from dgraph_tpu.obs.footprint import plan_footprint
+
+    W, F = 4, 8
+    edges, part = _graph(rng, W)
+    plan, _ = pl.build_edge_plan(edges, part, world_size=W)
+    sched = plan.halo_schedule
+    fp = plan_footprint(plan, "float32", feat_dim=F)
+    sched_fp = fp["collectives"]["halo_exchange"]["sched"]
+    assert sched_fp["rounds"] == sched.num_rounds
+    assert sched_fp["schedule_id"] == sched.schedule_id
+    assert sum(sched_fp["round_bytes_per_shard"]) == (
+        sched_fp["operand_bytes_per_shard"]
+    )
+
+    saved = cfg.halo_impl
+    cfg.set_flags(halo_impl="sched")
+    try:
+        mesh = make_graph_mesh(
+            ranks_per_graph=W, devices=jax.devices()[:W]
+        )
+        xs = np.zeros((W, plan.n_src_pad, F), np.float32)
+        jaxpr = jax.make_jaxpr(
+            lambda p, x: spmd_apply(
+                mesh, collectives.gather, p, x, static_args=("src", "graph")
+            )
+        )(plan, jnp.asarray(xs))
+    finally:
+        cfg.set_flags(halo_impl=saved)
+    traced = sorted(r["bytes"] for r in collect_collectives(jaxpr)["ppermute"])
+    assert traced == sorted(sched_fp["round_bytes_per_shard"]), (
+        "traced per-round operand bytes != footprint-priced rounds"
+    )
+
+
+# ---------------------------------------------------------------------------
+# execution: bit-identical to all_to_all, forward and backward
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=[2, 4])
+def sched_case(request, rng):
+    W = request.param
+    V, E = (48, 300) if W == 2 else (96, 600)
+    edges, part = _graph(rng, W, V, E)
+    plan, layout = pl.build_edge_plan(edges, part, world_size=W)
+    assert plan.halo_schedule is not None
+    mesh = make_graph_mesh(ranks_per_graph=W, num_replicas=8 // W)
+    return W, edges, part, plan, layout, mesh
+
+
+@pytest.fixture
+def sched_impl():
+    saved = cfg.halo_impl
+    yield
+    cfg.set_flags(halo_impl=saved)
+
+
+def _run_both(fn):
+    """fn() under halo_impl='sched' and ='all_to_all' -> (sched, a2a)."""
+    out = {}
+    for impl in ("sched", "all_to_all"):
+        cfg.set_flags(halo_impl=impl)
+        out[impl] = np.asarray(fn())
+    return out["sched"], out["all_to_all"]
+
+
+def test_sched_gather_bit_identical(sched_case, sched_impl, rng):
+    W, edges, part, plan, layout, mesh = sched_case
+    V, F = len(part), 6
+    x = rng.normal(size=(V, F)).astype(np.float32)
+    xs = jnp.asarray(shard_vertex_data(x, layout.src_counts, plan.n_src_pad))
+    got, want = _run_both(lambda: spmd_apply(
+        mesh, collectives.gather, plan, xs, static_args=("src", "graph")
+    ))
+    assert (got == want).all(), "sched forward differs from all_to_all"
+    np.testing.assert_allclose(
+        unshard_edge_data(got, layout), dense_gather(x, edges, "src"),
+        rtol=1e-6,
+    )
+
+
+def test_sched_gather_grad_bit_identical(sched_case, sched_impl, rng):
+    W, edges, part, plan, layout, mesh = sched_case
+    V, F = len(part), 3
+    x = rng.normal(size=(V, F)).astype(np.float32)
+    xs = jnp.asarray(shard_vertex_data(x, layout.src_counts, plan.n_src_pad))
+    ct = rng.normal(size=(edges.shape[1], F)).astype(np.float32)
+    ct_sh = jnp.asarray(shard_edge_data(ct, layout, plan.e_pad))
+
+    def grad_once():
+        def loss_fn(xs_):
+            out = spmd_apply(
+                mesh, collectives.gather, plan, xs_,
+                static_args=("src", "graph"),
+            )
+            return jnp.sum(out * ct_sh)
+
+        with jax.set_mesh(mesh):
+            return jax.jit(jax.grad(loss_fn))(xs)
+
+    got, want = _run_both(grad_once)
+    assert (got == want).all(), "sched backward differs from all_to_all"
+    np.testing.assert_allclose(
+        unshard_vertex_data(got, layout.src_counts),
+        dense_scatter_sum(ct, edges, "src", V), rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_sched_scatter_sum_bit_identical(sched_case, sched_impl, rng):
+    W, edges, part, plan, layout, mesh = sched_case
+    V, F = len(part), 4
+    edata = rng.normal(size=(edges.shape[1], F)).astype(np.float32)
+    ed = jnp.asarray(shard_edge_data(edata, layout, plan.e_pad))
+    got, want = _run_both(lambda: spmd_apply(
+        mesh, collectives.scatter_sum, plan, ed, static_args=("src", "graph")
+    ))
+    assert (got == want).all(), "sched scatter differs from all_to_all"
+    np.testing.assert_allclose(
+        unshard_vertex_data(got, layout.src_counts),
+        dense_scatter_sum(edata, edges, "src", V), rtol=1e-5, atol=1e-5,
+    )
