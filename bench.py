@@ -564,6 +564,15 @@ def bench_gcn(dtype_name: str):
         sched_available=_schedule is not None,
         pair_rows=getattr(plan_np, "halo_pair_rows", ()),
     )
+    # the RESOLVED wire format rides the JSON the same way: which codec
+    # this run's halo payloads would ship with, who decided (env > record
+    # > plan > fp32 default), and the operator's raw env pin
+    from dgraph_tpu.wire.spec import resolve_wire_format
+
+    wire_format, wire_format_source = resolve_wire_format(
+        plan_np.world_size, tuple(plan_np.halo_deltas),
+        plan_format=getattr(plan_np, "wire_format", "fp32"),
+    )
     split_info = {
         "interior_edge_frac": round(edge_split["interior_frac"], 4),
         "boundary_edge_frac": round(edge_split["boundary_frac"], 4),
@@ -571,6 +580,9 @@ def bench_gcn(dtype_name: str):
         "halo_impl": halo_impl,
         "halo_impl_source": halo_impl_source,
         "halo_impl_env_pin": _dcfg.halo_impl,
+        "wire_format": wire_format,
+        "wire_format_source": wire_format_source,
+        "wire_format_env_pin": _dcfg.wire_format,
         # compiled-schedule identity (dgraph_tpu.sched): the content hash
         # names the exact round order this plan would replay under
         # halo_impl='sched', whether or not sched was the resolved impl
@@ -592,6 +604,26 @@ def bench_gcn(dtype_name: str):
             "round_rows": list(_schedule.round_rows()),
             "git_rev": _git_rev(),
         })
+    # the resolved wire format joins the ledger too: operand_bytes rides
+    # regress's byte-exact class, so a codec or pricing change that
+    # alters what this workload ships on the wire goes RED across
+    # commits (footprint prices the exchange at the resolved format)
+    from dgraph_tpu.obs.footprint import plan_footprint
+
+    _fp_ex = plan_footprint(
+        plan_np, dtype_name, H
+    )["collectives"]["halo_exchange"]
+    _ledger_ingest({
+        "kind": "wire_compile",
+        "workload": {"world_size": plan_np.world_size,
+                     "nodes": Vp, "hidden": H},
+        "wire_format": wire_format,
+        "wire_format_source": wire_format_source,
+        "halo_impl": halo_impl,
+        "operand_bytes": _fp_ex["operand_bytes_per_shard"],
+        "compression_ratio": _fp_ex["compression_ratio"],
+        "git_rev": _git_rev(),
+    })
     if dt_ms != dt_ms:  # NaN timing: no roofline numbers (keep JSON valid;
         # the record id still rides along — a null metric must stay
         # attributable to the config that failed to produce it)

@@ -242,6 +242,26 @@ _FIXTURES = {
             "    return jax.jit(lambda y: y * 2)(x)\n"
         ),
     },
+    # an ad-hoc narrowing cast next to a collective ships bytes the
+    # footprint/trace/tuner pipeline never prices; the sanctioned shape
+    # is the wire codec pair (encode before the exchange, decode after,
+    # both priced). Casting to x.dtype (no literal) stays green.
+    "no-unpriced-wire-cast": {
+        "path": "dgraph_tpu/comm/collectives.py",
+        "bad": (
+            "from jax import lax\n"
+            "def exchange(x, axis):\n"
+            "    send = x.astype('bfloat16')\n"
+            "    return lax.all_to_all(send, axis, 0, 0)\n"
+        ),
+        "good": (
+            "from jax import lax\n"
+            "from dgraph_tpu.wire.codec import make_wire_transform\n"
+            "def exchange(x, axis, enc, dec):\n"
+            "    recv = lax.all_to_all(enc(x), axis, 0, 0)\n"
+            "    return dec(recv).astype(x.dtype)\n"
+        ),
+    },
 }
 
 # the rank-env spelling of the same divergence (os.environ[RANK_ENV_VAR]
@@ -460,6 +480,25 @@ def _lint_fixture_checks(failures: list) -> None:
         if not L._suppressed(src.splitlines(), f.line, f.rule)
     ]
     _check(failures, not got, "pragma did not suppress a finding")
+    # ...and the wire-cast rule honors the same pragma (an allowed cast
+    # is a documented, greppable decision, e.g. a diagnostic-only path)
+    src = (
+        "from jax import lax\n"
+        "def exchange(x, axis):\n"
+        "    send = x.astype('bfloat16')  # lint: allow(no-unpriced-wire-cast)\n"
+        "    return lax.all_to_all(send, axis, 0, 0)\n"
+    )
+    got = L.RULES["no-unpriced-wire-cast"].check(
+        "dgraph_tpu/comm/collectives.py", ast.parse(src), src.splitlines(),
+    )
+    got = [
+        f for f in got
+        if not L._suppressed(src.splitlines(), f.line, f.rule)
+    ]
+    _check(
+        failures, not got,
+        "pragma did not suppress a no-unpriced-wire-cast finding",
+    )
     # transitive module-level check: importing a dgraph_tpu module that
     # itself imports jax at module level must fire
     with tempfile.TemporaryDirectory(prefix="dgraph_lint_selftest_") as tmp:

@@ -87,6 +87,9 @@ _MLIR_DTYPES = {
     "i64": ("int64", 8), "i32": ("int32", 4),
     "i16": ("int16", 2), "i8": ("int8", 1), "i1": ("bool", 1),
     "ui64": ("uint64", 8), "ui32": ("uint32", 4), "ui8": ("uint8", 1),
+    # fp8 wire payloads ride collectives bitcast to ui8, but the e4m3
+    # element type itself can appear in surrounding compute
+    "f8E4M3FN": ("float8_e4m3fn", 1),
 }
 
 # interpret-mode DMA discharge artifact shape: per remote put, the
@@ -296,7 +299,9 @@ def _audit_one_lowering(
     for rec in coll["all_gather"]:
         if impl == "pallas_p2p":
             if (
-                rec["dtype"] in ("float32", "bfloat16", "float16")
+                # uint8: the fp8 wire payload — shape (not dtype) is what
+                # identifies the [.., S, F_wire] send tile either way
+                rec["dtype"] in ("float32", "bfloat16", "float16", "uint8")
                 and len(rec["shape"]) >= 2
                 and rec["shape"][-2] == S
             ):

@@ -211,6 +211,14 @@ JAX_FREE_TARGETS = (
     # comm/collectives.py replays the schedule and is the ONE jax
     # consumer, deliberately outside this list.
     "dgraph_tpu/sched/",
+    # the wire-format registry, dedup planner, and their selftest: wire
+    # formats are DATA (resolved, priced, serialized into plans and
+    # tuning records) on the same backend-less hosts as the schedule
+    # compiler — wire/codec.py holds the jax encode/decode pairs and is
+    # deliberately outside this list (wire/__init__ lazy-exports it)
+    "dgraph_tpu/wire/spec.py",
+    "dgraph_tpu/wire/dedup.py",
+    "dgraph_tpu/wire/__main__.py",
 )
 
 
@@ -831,6 +839,81 @@ def check_monolithic_plan_pickle(relpath: str, tree: ast.AST, lines: list):
                     f"plan.build_plan_shards), not one monolithic pickle",
                 ))
                 break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# no-unpriced-wire-cast
+# ---------------------------------------------------------------------------
+
+# dtypes narrower than fp32 whose literal spelling in a cast marks a
+# deliberate narrowing (a cast to ``x.dtype`` / a widening to f32 never
+# matches)
+NARROW_DTYPES = frozenset({
+    "bfloat16", "float16", "float8_e4m3fn", "float8_e5m2", "int8", "uint8",
+})
+# calls that put an operand on the wire: the lax collectives plus the
+# pallas p2p transport entry point
+WIRE_EXCHANGE_CALLS = COLLECTIVE_CALLS | frozenset({"p2p_transport"})
+
+
+def _narrow_dtype_literal(node) -> Optional[str]:
+    """The narrow dtype a cast argument names literally, else None."""
+    if isinstance(node, ast.Constant) and node.value in NARROW_DTYPES:
+        return str(node.value)
+    if isinstance(node, ast.Attribute) and node.attr in NARROW_DTYPES:
+        return node.attr
+    if isinstance(node, ast.Name) and node.id in NARROW_DTYPES:
+        return node.id
+    return None
+
+
+@rule(
+    "no-unpriced-wire-cast",
+    "no literal dtype-narrowing astype/convert_element_type in a function "
+    "that puts operands on the wire (issues a lax collective or the p2p "
+    "transport): an ad-hoc cast ships bytes the footprint model, trace/HLO "
+    "auditors and tuner never price — narrowing wire payloads is "
+    "dgraph_tpu.wire's job (encode/decode pairs, priced end to end)",
+    path_matcher("dgraph_tpu/comm/", "dgraph_tpu/ops/"),
+    scope="comm/, ops/",
+)
+def check_unpriced_wire_cast(relpath: str, tree: ast.AST, lines: list):
+    findings = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        issues = [
+            sub.lineno for sub in ast.walk(fn)
+            if isinstance(sub, ast.Call)
+            and _last_segment(sub.func) in WIRE_EXCHANGE_CALLS
+        ]
+        if not issues:
+            continue
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            last = _last_segment(sub.func)
+            arg = None
+            if last == "astype" and sub.args:
+                arg = sub.args[0]
+            elif last == "convert_element_type":
+                cands = list(sub.args[1:]) + [
+                    k.value for k in sub.keywords if k.arg == "new_dtype"
+                ]
+                arg = cands[0] if cands else None
+            dt = _narrow_dtype_literal(arg) if arg is not None else None
+            if dt:
+                findings.append(Finding(
+                    "no-unpriced-wire-cast", relpath, sub.lineno,
+                    f"literal narrowing cast to {dt!r} inside {fn.name!r} "
+                    f"(line {fn.lineno}), which puts operands on the wire "
+                    f"(exchange call at line {issues[0]}): those bytes are "
+                    f"invisible to footprint/trace/tuner — route narrowing "
+                    f"through dgraph_tpu.wire (make_wire_transform / "
+                    f"make_*_codec) so the encoded payload is priced and "
+                    f"verified end to end",
+                ))
     return findings
 
 

@@ -119,6 +119,10 @@ _STATIC_FIELDS = (
     # (dgraph_tpu.sched): a rank whose matrix row drifted compiles a
     # different round order — the deadlock class the sched lowering adds
     "halo_pair_rows", "halo_schedule",
+    # the wire format attached at build time (dgraph_tpu.wire): a rank
+    # whose format drifted encodes collective operands at a different
+    # dtype/width — every exchange rendezvous disagrees on byte counts
+    "wire_format",
 )
 
 
@@ -505,25 +509,34 @@ def resolution_agreement(
     sched_available: bool = False,
     pair_rows: tuple = (),
     rank_tuned: Optional[Dict[int, Optional[str]]] = None,
+    plan_wire_format: str = "fp32",
+    rank_tuned_wire: Optional[Dict[int, Optional[str]]] = None,
     failures: Optional[list] = None,
 ) -> dict:
-    """Resolve the halo lowering PER RANK through the real
-    :func:`~dgraph_tpu.plan.resolve_halo_impl` ladder, each rank under
-    its own (simulated) adopted tuning record — divergent resolution
-    means the ranks would not even agree on the transport family, a
+    """Resolve the halo lowering AND the wire format PER RANK through
+    the real :func:`~dgraph_tpu.plan.resolve_halo_impl` /
+    :func:`~dgraph_tpu.wire.spec.resolve_wire_format` ladders, each rank
+    under its own (simulated) adopted tuning record — divergent
+    resolution means the ranks would not even agree on the transport
+    family (or would encode collective operands at different widths), a
     deadlock before the first exchange.  Appends to ``failures`` and
-    returns ``{rank: [impl, source]}``."""
+    returns ``{rank: [impl, source, wire_format, wire_source]}``."""
     from dgraph_tpu import config as _cfg
     from dgraph_tpu.plan import resolve_halo_impl
+    from dgraph_tpu.wire.spec import resolve_wire_format
 
     rank_tuned = rank_tuned or {}
+    rank_tuned_wire = rank_tuned_wire or {}
     out = {}
-    saved = (_cfg.halo_impl, _cfg.tuned_halo_impl)
+    saved = (_cfg.halo_impl, _cfg.tuned_halo_impl,
+             _cfg.wire_format, _cfg.tuned_wire_format)
     try:
         for r in range(world_size):
             with _rank_env(r):
                 _cfg.set_flags(
-                    halo_impl="auto", tuned_halo_impl=rank_tuned.get(r)
+                    halo_impl="auto", tuned_halo_impl=rank_tuned.get(r),
+                    wire_format="auto",
+                    tuned_wire_format=rank_tuned_wire.get(r),
                 )
                 impl, source = resolve_halo_impl(
                     world_size, tuple(halo_deltas),
@@ -532,15 +545,32 @@ def resolution_agreement(
                     sched_available=sched_available,
                     pair_rows=pair_rows,
                 )
-                out[r] = [impl, source]
+                wf, wf_source = resolve_wire_format(
+                    world_size, tuple(halo_deltas),
+                    plan_format=plan_wire_format,
+                )
+                out[r] = [impl, source, wf, wf_source]
     finally:
-        _cfg.set_flags(halo_impl=saved[0], tuned_halo_impl=saved[1])
-    if failures is not None and len({tuple(v) for v in out.values()}) > 1:
-        failures.append(
-            f"[spmd:resolution] ranks resolve DIFFERENT halo lowerings: "
-            f"{out} — a rank-divergent tuned record (or env pin) splits "
-            f"the transport family before the first exchange"
+        _cfg.set_flags(
+            halo_impl=saved[0], tuned_halo_impl=saved[1],
+            wire_format=saved[2], tuned_wire_format=saved[3],
         )
+    if failures is not None:
+        if len({(v[0], v[1]) for v in out.values()}) > 1:
+            failures.append(
+                f"[spmd:resolution] ranks resolve DIFFERENT halo "
+                f"lowerings: {out} — a rank-divergent tuned record (or "
+                f"env pin) splits the transport family before the first "
+                f"exchange"
+            )
+        if len({(v[2], v[3]) for v in out.values()}) > 1:
+            failures.append(
+                f"[spmd:resolution] ranks resolve DIFFERENT wire "
+                f"formats: {out} — a rank-divergent tuned record (or "
+                f"env pin) makes peers encode/decode collective operands "
+                f"at different widths; every rendezvous disagrees on "
+                f"byte counts"
+            )
     return out
 
 
@@ -567,6 +597,7 @@ def audit_plan_dir_spmd(
     impls=HALO_IMPLS,
     programs: Optional[dict] = None,
     rank_tuned: Optional[Dict[int, Optional[str]]] = None,
+    rank_tuned_wire: Optional[Dict[int, Optional[str]]] = None,
     label: str = "",
     workload_kwargs: Optional[dict] = None,
 ) -> dict:
@@ -614,7 +645,9 @@ def audit_plan_dir_spmd(
         W, halo_deltas, overlap_available=base.get("overlap", False),
         sched_available=base.get("halo_schedule") is not None,
         pair_rows=base.get("halo_pair_rows", ()),
-        rank_tuned=rank_tuned, failures=failures,
+        rank_tuned=rank_tuned,
+        plan_wire_format=base.get("wire_format", "fp32"),
+        rank_tuned_wire=rank_tuned_wire, failures=failures,
     )
 
     # per-rank workloads, built under each rank's env (skipped when the
@@ -1042,6 +1075,27 @@ def spmd_selftest(log=None, *, seed: int = 0) -> dict:
             failures,
             any("resolution" in f for f in rep["failures"]),
             f"divergent tune record was red for the wrong reason: "
+            f"{rep['failures'][:2]}",
+        )
+
+        # a rank-divergent adopted WIRE-FORMAT record must likewise fail
+        # resolution agreement before anything lowers (rank 1 encodes
+        # bf16 while rank 0 sends fp32 — byte counts disagree at every
+        # rendezvous)
+        rep = audit_plan_dir_spmd(
+            w4_dir, impls=(), programs={},
+            rank_tuned_wire={0: None, 1: "bf16"},
+            label="mutant_wire",
+        )
+        mutants["divergent_wire_record"] = not rep["ok"]
+        _check(
+            failures, not rep["ok"],
+            "auditor accepted rank-divergent wire-format resolution",
+        )
+        _check(
+            failures,
+            any("wire" in f for f in rep["failures"]),
+            f"divergent wire record was red for the wrong reason: "
             f"{rep['failures'][:2]}",
         )
 

@@ -119,12 +119,17 @@ def collect_collectives(jaxpr) -> dict:
             puts = _remote_put_count(getattr(inner, "jaxpr", inner))
             if not puts:
                 return
-            blocks = [
+            rank3 = [
                 v.aval for v in eqn.invars
                 if hasattr(getattr(v, "aval", None), "shape")
                 and len(v.aval.shape) == 3
-                and "int" not in str(v.aval.dtype)
             ]
+            blocks = [a for a in rank3 if "int" not in str(a.dtype)]
+            if not blocks:
+                # fp8 wire payloads ride as uint8: the encoded send-tile
+                # stack is still the transport's one rank-3 payload (the
+                # index operands the float filter exists to skip are i32)
+                blocks = [a for a in rank3 if str(a.dtype) == "uint8"]
             for aval in blocks[:1]:
                 out["pallas_p2p"].append({
                     "primitive": "pallas_p2p",
@@ -389,9 +394,24 @@ def _expected_bytes(plan, dtype: str, feat_dim: int) -> dict:
     """What obs.footprint prices for ONE exchange at this width/dtype:
     the padded all_to_all operand and the per-round ppermute block. Pulled
     from :func:`plan_footprint` itself (not re-derived) so the audit pins
-    the exact numbers the tuner ranks on."""
-    from dgraph_tpu.obs.footprint import plan_footprint
+    the exact numbers the tuner ranks on.
 
+    ``dtype``/``feat_dim`` come from the TRACED collective operand. Under
+    a non-identity wire format that operand is already encoded — bf16
+    casts price themselves (footprint resolves the same format at the
+    traced itemsize), but the fp8 operand is uint8 with the 4 scale lanes
+    concatenated into its last axis, so the activation width is recovered
+    before pricing (wire_row_bytes then reproduces the traced last-dim
+    exactly)."""
+    from dgraph_tpu.obs.footprint import plan_footprint
+    from dgraph_tpu.wire.spec import FP8_SCALE_BYTES, resolve_wire_format
+
+    wf, _src = resolve_wire_format(
+        plan.world_size, tuple(plan.halo_deltas),
+        plan_format=getattr(plan, "wire_format", "fp32"),
+    )
+    if wf == "fp8" and dtype == "uint8":
+        feat_dim = feat_dim - FP8_SCALE_BYTES
     fp = plan_footprint(plan, dtype, feat_dim=feat_dim)
     ex = fp["collectives"]["halo_exchange"]
     n_deltas = fp["num_halo_deltas"]

@@ -62,6 +62,41 @@ def resolve_plan_impl(plan: EdgePlan, axis_name) -> str:
     return impl
 
 
+def resolve_plan_wire_format(plan: EdgePlan, axis_name) -> str:
+    """The wire format THIS call site will encode halo payloads with —
+    resolved exactly ONCE (env pin > adopted tuning record > the plan's
+    build-time attachment > fp32 identity;
+    :func:`dgraph_tpu.wire.spec.resolve_wire_format`) and threaded as a
+    static ``wire_format`` argument into every leg of the op, for the
+    same reason :func:`resolve_plan_impl` resolves once: a mid-run flag
+    flip must never hand the forward exchange and its transpose
+    DIFFERENT codecs inside one jitted step."""
+    if axis_name is None:
+        return "fp32"
+    from dgraph_tpu.wire.spec import resolve_wire_format
+
+    name, _source = resolve_wire_format(
+        plan.world_size, tuple(plan.halo_deltas),
+        plan_format=getattr(plan, "wire_format", "fp32"),
+    )
+    return name
+
+
+def _wire_fns(wire_format, dtype):
+    """Raw (encode, decode) for this format at this activation dtype —
+    ``(None, None)`` keeps the caller's pre-codec code path byte-for-byte
+    unchanged (the fp32 identity guarantee). Only called from inside the
+    custom-VJP round executors, whose bodies are opaque to AD; plain-AD
+    paths go through the wire-trip wrappers instead (an fp8 payload is a
+    uint8 operand, and AD through an integer intermediate silently drops
+    the gradient)."""
+    if wire_format in (None, "fp32"):
+        return None, None
+    from dgraph_tpu.wire.codec import make_wire_transform
+
+    return make_wire_transform(wire_format, str(jnp.dtype(dtype)))
+
+
 def _resolve_halo_arg(impl, deltas, W) -> str:
     """Resolution for call sites that only hold a HaloSpec (no plan):
     ``impl=None`` resolves here; ``deltas=None`` means the caller carries
@@ -109,12 +144,13 @@ def halo_exchange_split(x, plan: EdgePlan, axis_name) -> jax.Array:
     produce the same ``[W*S, F]`` halo buffer the boundary takes index
     directly (and bit-identical values)."""
     impl = resolve_plan_impl(plan, axis_name)
+    wf = resolve_plan_wire_format(plan, axis_name)
     if impl == "pallas_p2p":
         return halo_exchange_p2p(
-            x, plan.halo, axis_name, tuple(plan.halo_deltas)
+            x, plan.halo, axis_name, tuple(plan.halo_deltas), wf
         )
     return halo_exchange_overlap(
-        x, plan.halo, axis_name, tuple(plan.halo_deltas)
+        x, plan.halo, axis_name, tuple(plan.halo_deltas), wf
     )
 
 
@@ -160,21 +196,26 @@ def shard_map_checks(
     return {}
 
 
-def _overlap_rounds_fwd(x, send_idx, send_mask, axis_name, deltas, W, S):
+def _overlap_rounds_fwd(x, send_idx, send_mask, axis_name, deltas, W, S,
+                        wire_format="fp32"):
     """Double-buffered ppermute rounds: every round's send block is
     gathered up front and every CollectivePermute is issued before any
     received block is placed, so XLA's latency-hiding scheduler is free to
     run independent compute (the interior aggregation the callers
     interleave) while the wire is busy. Result layout and values are
-    bit-identical to the padded all_to_all lowering."""
+    bit-identical to the padded all_to_all lowering (under the same
+    ``wire_format``: each round's masked block is encoded per-row exactly
+    as the a2a operand would be)."""
     F = x.shape[-1]
     me = lax.axis_index(axis_name)
+    enc, dec = _wire_fns(wire_format, x.dtype)
     sends = []
     for d in deltas:
         peer_row = (me + d) % W
         idx = jnp.take(send_idx, peer_row, axis=0)
         msk = jnp.take(send_mask, peer_row, axis=0)
-        sends.append(x[idx] * msk[..., None].astype(x.dtype))  # [S, F]
+        blk = x[idx] * msk[..., None].astype(x.dtype)  # [S, F]
+        sends.append(enc(blk) if enc is not None else blk)
     recvs = [
         lax.ppermute(s, axis_name, [(i, (i + d) % W) for i in range(W)])
         for s, d in zip(sends, deltas)
@@ -182,23 +223,31 @@ def _overlap_rounds_fwd(x, send_idx, send_mask, axis_name, deltas, W, S):
     out = jnp.zeros((W * S, F), x.dtype)
     for d, recv in zip(deltas, recvs):
         src_rank = (me - d) % W
+        if dec is not None:
+            recv = dec(recv)
         out = lax.dynamic_update_slice(out, recv, (src_rank * S, 0))
     return out
 
 
-def _overlap_rounds_rev(h, send_idx, send_mask, n_pad, axis_name, deltas, W, S):
+def _overlap_rounds_rev(h, send_idx, send_mask, n_pad, axis_name, deltas, W, S,
+                        wire_format="fp32"):
     """Reverse of :func:`_overlap_rounds_fwd`: all reverse ppermutes are
     issued up front; the returned blocks are then placed into one [W, S]
     buffer and reduced with the SAME masked flat segment-sum the
     all_to_all path uses — so values are bit-identical to it, while the
-    rounds themselves stay individually overlappable."""
+    rounds themselves stay individually overlappable. The returning
+    cotangent blocks ride the wire encoded with the same format as the
+    forward payloads (decode happens BEFORE the mask-and-reduce, so the
+    accumulation runs at the activation dtype)."""
     F = h.shape[-1]
     me = lax.axis_index(axis_name)
+    enc, dec = _wire_fns(wire_format, h.dtype)
     h = h.reshape(W * S, F)
     blocks = []
     for d in deltas:
         src_rank = (me - d) % W
-        blocks.append(lax.dynamic_slice(h, (src_rank * S, 0), (S, F)))
+        blk = lax.dynamic_slice(h, (src_rank * S, 0), (S, F))
+        blocks.append(enc(blk) if enc is not None else blk)
     recvs = [
         lax.ppermute(b, axis_name, [(i, (i - d) % W) for i in range(W)])
         for b, d in zip(blocks, deltas)
@@ -206,6 +255,8 @@ def _overlap_rounds_rev(h, send_idx, send_mask, n_pad, axis_name, deltas, W, S):
     back = jnp.zeros((W, S, F), h.dtype)
     for d, recv in zip(deltas, recvs):
         peer_row = (me + d) % W
+        if dec is not None:
+            recv = dec(recv)
         back = lax.dynamic_update_slice(back, recv[None], (peer_row, 0, 0))
     back = back * send_mask[..., None].astype(back.dtype)
     flat_idx = send_idx.reshape(-1)
@@ -213,18 +264,23 @@ def _overlap_rounds_rev(h, send_idx, send_mask, n_pad, axis_name, deltas, W, S):
 
 
 @functools.lru_cache(maxsize=None)
-def _make_overlap_pair(axis_name, deltas, W, S, n_pad):
+def _make_overlap_pair(axis_name, deltas, W, S, n_pad, wire_format="fp32",
+                       dtype_name="float32"):
     """The overlap exchange/unexchange custom-VJP pair. Mirrors the
     existing gather/scatter adjoint structure: the exchange's backward IS
     the reverse rounds (halo values delivered back to their owners) and
     the reverse's backward IS the forward rounds — pinned explicitly so
     the transpose keeps the double-buffered round schedule (JAX's default
     transpose would serialize placement chains) and keeps the masked
-    segment-sum on the fast wrapper paths."""
+    segment-sum on the fast wrapper paths. The cache key carries the
+    (static) wire format + activation dtype, so two configurations never
+    share an executor — and because these bodies are opaque to AD, the
+    codec's integer payloads (fp8) are safe inside them."""
 
     @jax.custom_vjp
     def exchange(x, send_idx, send_mask):
-        return _overlap_rounds_fwd(x, send_idx, send_mask, axis_name, deltas, W, S)
+        return _overlap_rounds_fwd(x, send_idx, send_mask, axis_name, deltas,
+                                   W, S, wire_format)
 
     def ex_fwd(x, send_idx, send_mask):
         return exchange(x, send_idx, send_mask), (send_idx, send_mask)
@@ -232,7 +288,8 @@ def _make_overlap_pair(axis_name, deltas, W, S, n_pad):
     def ex_bwd(res, g):
         send_idx, send_mask = res
         dx = _overlap_rounds_rev(
-            g, send_idx, send_mask, n_pad, axis_name, deltas, W, S)
+            g, send_idx, send_mask, n_pad, axis_name, deltas, W, S,
+            wire_format)
         return dx, None, None
 
     exchange.defvjp(ex_fwd, ex_bwd)
@@ -240,28 +297,35 @@ def _make_overlap_pair(axis_name, deltas, W, S, n_pad):
     @jax.custom_vjp
     def unexchange(h, send_idx, send_mask):
         return _overlap_rounds_rev(
-            h, send_idx, send_mask, n_pad, axis_name, deltas, W, S)
+            h, send_idx, send_mask, n_pad, axis_name, deltas, W, S,
+            wire_format)
 
     def un_fwd(h, send_idx, send_mask):
         return unexchange(h, send_idx, send_mask), (send_idx, send_mask)
 
     def un_bwd(res, g):
         send_idx, send_mask = res
-        dh = _overlap_rounds_fwd(g, send_idx, send_mask, axis_name, deltas, W, S)
+        dh = _overlap_rounds_fwd(g, send_idx, send_mask, axis_name, deltas,
+                                 W, S, wire_format)
         return dh, None, None
 
     unexchange.defvjp(un_fwd, un_bwd)
     return exchange, unexchange
 
 
-def _p2p_rounds_fwd(x, send_idx, send_mask, axis_name, deltas, W, S):
+def _p2p_rounds_fwd(x, send_idx, send_mask, axis_name, deltas, W, S,
+                    wire_format="fp32"):
     """One-sided put schedule: gather each live delta's send tile exactly
     like the a2a path gathers its blocks, then hand the stack to the
     Pallas transport — the masking multiply fuses into the kernel (exact
     elementwise op, staged in VMEM, overlapped with the previous tile's
     in-flight put) and every tile DMAs straight into the destination
     shard's halo buffer. Result layout and values are bit-identical to
-    the padded all_to_all lowering."""
+    the padded all_to_all lowering. Non-fp32 wire formats apply the mask
+    BEFORE encoding (per-row fp8 scales depend only on the masked row, so
+    the wire bytes match the a2a operand exactly) and ship the encoded
+    tiles with ``mask=None`` — the kernel is dtype-generic and treats
+    pre-masked tiles as pure data movement."""
     from dgraph_tpu.ops import pallas_p2p as _p2p
 
     me = lax.axis_index(axis_name)
@@ -269,15 +333,24 @@ def _p2p_rounds_fwd(x, send_idx, send_mask, axis_name, deltas, W, S):
     peer_rows = (me + d) % W
     blocks = x[send_idx[peer_rows]]  # [n, S, F]
     msk = send_mask[peer_rows]  # [n, S]
-    return _p2p.p2p_transport(blocks, axis_name, deltas, W, S, mask=msk)
+    enc, dec = _wire_fns(wire_format, x.dtype)
+    if enc is None:
+        return _p2p.p2p_transport(blocks, axis_name, deltas, W, S, mask=msk)
+    wire = enc(blocks * msk[..., None].astype(x.dtype))
+    out = _p2p.p2p_transport(wire, axis_name, deltas, W, S)
+    return dec(out.reshape(W, S, -1)).reshape(W * S, -1)
 
 
-def _p2p_rounds_rev(h, send_idx, send_mask, n_pad, axis_name, deltas, W, S):
+def _p2p_rounds_rev(h, send_idx, send_mask, n_pad, axis_name, deltas, W, S,
+                    wire_format="fp32"):
     """Reverse of :func:`_p2p_rounds_fwd`: each delta's halo-slot block
     flies back to its owner as a one-sided put (``sign=-1`` mirrors the
     forward targets), lands in the same per-source-rank layout the
     all_to_all reverse produces, and reduces with the SAME masked flat
-    segment-sum — bit-identical values, one-sided transport."""
+    segment-sum — bit-identical values, one-sided transport. Cotangent
+    blocks are encoded UNMASKED (mask applies after decode, exactly as
+    the other reverse lowerings order it) so the per-row wire bytes match
+    the a2a reverse operand."""
     from dgraph_tpu.ops import pallas_p2p as _p2p
 
     F = h.shape[-1]
@@ -285,25 +358,35 @@ def _p2p_rounds_rev(h, send_idx, send_mask, n_pad, axis_name, deltas, W, S):
     d = jnp.asarray(deltas, jnp.int32)
     src_rows = (me - d) % W
     blocks = h.reshape(W, S, F)[src_rows]  # [n, S, F]
-    back = _p2p.p2p_transport(blocks, axis_name, deltas, W, S, sign=-1)
-    back = back.reshape(W, S, F) * send_mask[..., None].astype(h.dtype)
+    enc, dec = _wire_fns(wire_format, h.dtype)
+    if enc is None:
+        back = _p2p.p2p_transport(blocks, axis_name, deltas, W, S, sign=-1)
+        back = back.reshape(W, S, F)
+    else:
+        wire = _p2p.p2p_transport(enc(blocks), axis_name, deltas, W, S,
+                                  sign=-1)
+        back = dec(wire.reshape(W, S, -1))
+    back = back * send_mask[..., None].astype(h.dtype)
     flat_idx = send_idx.reshape(-1)
     return local_ops.segment_sum(back.reshape(W * S, -1), flat_idx, n_pad)
 
 
 @functools.lru_cache(maxsize=None)
-def _make_p2p_pair(axis_name, deltas, W, S, n_pad):
+def _make_p2p_pair(axis_name, deltas, W, S, n_pad, wire_format="fp32",
+                   dtype_name="float32"):
     """The pallas_p2p exchange/unexchange custom-VJP pair — the exact
     mirror of :func:`_make_overlap_pair` with the ppermute rounds swapped
     for the one-sided transport: the exchange's backward IS the reverse
     puts (halo cotangents delivered back to their owners) and the
     reverse's backward IS the forward puts. Pinned explicitly so AD never
     differentiates through the pallas_call (the kernel is pure data
-    movement; its transpose is the mirrored transport)."""
+    movement; its transpose is the mirrored transport). Cache key carries
+    the static wire format + activation dtype like the overlap pair."""
 
     @jax.custom_vjp
     def exchange(x, send_idx, send_mask):
-        return _p2p_rounds_fwd(x, send_idx, send_mask, axis_name, deltas, W, S)
+        return _p2p_rounds_fwd(x, send_idx, send_mask, axis_name, deltas,
+                               W, S, wire_format)
 
     def ex_fwd(x, send_idx, send_mask):
         return exchange(x, send_idx, send_mask), (send_idx, send_mask)
@@ -311,7 +394,8 @@ def _make_p2p_pair(axis_name, deltas, W, S, n_pad):
     def ex_bwd(res, g):
         send_idx, send_mask = res
         dx = _p2p_rounds_rev(
-            g, send_idx, send_mask, n_pad, axis_name, deltas, W, S)
+            g, send_idx, send_mask, n_pad, axis_name, deltas, W, S,
+            wire_format)
         return dx, None, None
 
     exchange.defvjp(ex_fwd, ex_bwd)
@@ -319,14 +403,16 @@ def _make_p2p_pair(axis_name, deltas, W, S, n_pad):
     @jax.custom_vjp
     def unexchange(h, send_idx, send_mask):
         return _p2p_rounds_rev(
-            h, send_idx, send_mask, n_pad, axis_name, deltas, W, S)
+            h, send_idx, send_mask, n_pad, axis_name, deltas, W, S,
+            wire_format)
 
     def un_fwd(h, send_idx, send_mask):
         return unexchange(h, send_idx, send_mask), (send_idx, send_mask)
 
     def un_bwd(res, g):
         send_idx, send_mask = res
-        dh = _p2p_rounds_fwd(g, send_idx, send_mask, axis_name, deltas, W, S)
+        dh = _p2p_rounds_fwd(g, send_idx, send_mask, axis_name, deltas,
+                             W, S, wire_format)
         return dh, None, None
 
     unexchange.defvjp(un_fwd, un_bwd)
@@ -339,6 +425,7 @@ def halo_exchange_p2p(
     halo: HaloSpec,
     axis_name: Optional[str],
     deltas: tuple,
+    wire_format: str = "fp32",
 ) -> jax.Array:
     """:func:`halo_exchange` lowered as device-initiated one-sided puts
     (``pltpu.make_async_remote_copy`` issued from inside the Pallas
@@ -351,7 +438,8 @@ def halo_exchange_p2p(
     W, S = halo.send_idx.shape[0], halo.s_pad
     if axis_name is None or not deltas:
         return halo_exchange(x, halo, axis_name, deltas=deltas, impl="none")
-    ex, _ = _make_p2p_pair(axis_name, tuple(deltas), W, S, x.shape[0])
+    ex, _ = _make_p2p_pair(axis_name, tuple(deltas), W, S, x.shape[0],
+                           wire_format, str(jnp.dtype(x.dtype)))
     return ex(x, halo.send_idx, halo.send_mask)
 
 
@@ -362,6 +450,7 @@ def halo_scatter_sum_p2p(
     n_pad: int,
     axis_name: Optional[str],
     deltas: tuple,
+    wire_format: str = "fp32",
 ) -> jax.Array:
     """:func:`halo_scatter_sum` lowered as reverse one-sided puts (the
     pallas_p2p pair's transpose): every halo-slot partial flies back to
@@ -372,7 +461,8 @@ def halo_scatter_sum_p2p(
     if axis_name is None or not deltas:
         return halo_scatter_sum(h, halo, n_pad, axis_name, deltas=deltas,
                                 impl="none")
-    _, unex = _make_p2p_pair(axis_name, tuple(deltas), W, S, n_pad)
+    _, unex = _make_p2p_pair(axis_name, tuple(deltas), W, S, n_pad,
+                             wire_format, str(jnp.dtype(h.dtype)))
     return unex(h, halo.send_idx, halo.send_mask)
 
 
@@ -382,6 +472,7 @@ def halo_exchange_overlap(
     halo: HaloSpec,
     axis_name: Optional[str],
     deltas: tuple,
+    wire_format: str = "fp32",
 ) -> jax.Array:
     """:func:`halo_exchange` lowered as double-buffered ppermute rounds
     built for compute–communication overlap: all sends are gathered and
@@ -393,7 +484,8 @@ def halo_exchange_overlap(
     W, S = halo.send_idx.shape[0], halo.s_pad
     if axis_name is None or not deltas:
         return halo_exchange(x, halo, axis_name, deltas=deltas, impl="none")
-    ex, _ = _make_overlap_pair(axis_name, tuple(deltas), W, S, x.shape[0])
+    ex, _ = _make_overlap_pair(axis_name, tuple(deltas), W, S, x.shape[0],
+                               wire_format, str(jnp.dtype(x.dtype)))
     return ex(x, halo.send_idx, halo.send_mask)
 
 
@@ -404,6 +496,7 @@ def halo_scatter_sum_overlap(
     n_pad: int,
     axis_name: Optional[str],
     deltas: tuple,
+    wire_format: str = "fp32",
 ) -> jax.Array:
     """:func:`halo_scatter_sum` lowered as double-buffered reverse
     ppermute rounds (the overlap pair's transpose): issue every reverse
@@ -414,11 +507,13 @@ def halo_scatter_sum_overlap(
     if axis_name is None or not deltas:
         return halo_scatter_sum(h, halo, n_pad, axis_name, deltas=deltas,
                                 impl="none")
-    _, unex = _make_overlap_pair(axis_name, tuple(deltas), W, S, n_pad)
+    _, unex = _make_overlap_pair(axis_name, tuple(deltas), W, S, n_pad,
+                                 wire_format, str(jnp.dtype(h.dtype)))
     return unex(h, halo.send_idx, halo.send_mask)
 
 
-def _sched_rounds_fwd(x, send_idx, send_mask, axis_name, schedule, W, S):
+def _sched_rounds_fwd(x, send_idx, send_mask, axis_name, schedule, W, S,
+                      wire_format="fp32"):
     """Replay a compiled :class:`~dgraph_tpu.sched.ir.HaloSchedule`:
     per round, every rank gathers + masks the send block for its (static)
     round peer and slices its transfer's row window; all ppermutes are
@@ -439,6 +534,7 @@ def _sched_rounds_fwd(x, send_idx, send_mask, axis_name, schedule, W, S):
     once."""
     F = x.shape[-1]
     me = lax.axis_index(axis_name)
+    enc, dec = _wire_fns(wire_format, x.dtype)
     rows = schedule.round_rows()
     c_max = max(rows)
     sends = []
@@ -449,7 +545,10 @@ def _sched_rounds_fwd(x, send_idx, send_mask, axis_name, schedule, W, S):
         idx = jnp.take(send_idx, dst, axis=0)
         msk = jnp.take(send_mask, dst, axis=0)
         blk = x[idx] * msk[..., None].astype(x.dtype)  # [S, F]
-        sends.append(lax.dynamic_slice(blk, (start, 0), (rows[k], F)))
+        blk = lax.dynamic_slice(blk, (start, 0), (rows[k], F))
+        # encode AFTER the row slice: per-row codecs commute with row
+        # slicing, so the wire bytes match the a2a operand's rows exactly
+        sends.append(enc(blk) if enc is not None else blk)
     recvs = [
         lax.ppermute(s, axis_name, schedule.rounds[k].pairs)
         for k, s in enumerate(sends)
@@ -458,12 +557,17 @@ def _sched_rounds_fwd(x, send_idx, send_mask, axis_name, schedule, W, S):
     for k, recv in enumerate(recvs):
         ra = schedule.rank_arrays(k)
         off = jnp.asarray(ra["place_off"], jnp.int32)[me]
+        if dec is not None:
+            # non-receivers get all-zero wire rows from ppermute, which
+            # every codec decodes to exactly 0.0 — the scratch band stays
+            # as clean as in the fp32 path
+            recv = dec(recv)
         out = lax.dynamic_update_slice(out, recv, (off, 0))
     return out[: W * S]
 
 
 def _sched_rounds_rev(h, send_idx, send_mask, n_pad, axis_name, schedule,
-                      W, S):
+                      W, S, wire_format="fp32"):
     """Reverse replay: per round, each fwd RECEIVER slices the cotangent
     window its transfer landed in and ppermutes it along the reversed
     pairs back to the fwd sender, which parks it in its ``[W+1, S, F]``
@@ -476,13 +580,15 @@ def _sched_rounds_rev(h, send_idx, send_mask, n_pad, axis_name, schedule,
     :func:`_overlap_rounds_rev`)."""
     F = h.shape[-1]
     me = lax.axis_index(axis_name)
+    enc, dec = _wire_fns(wire_format, h.dtype)
     h = h.reshape(W * S, F)
     rows = schedule.round_rows()
     blocks = []
     for k in range(schedule.num_rounds):
         ra = schedule.rank_arrays(k)
         off = jnp.asarray(ra["slice_off"], jnp.int32)[me]
-        blocks.append(lax.dynamic_slice(h, (off, 0), (rows[k], F)))
+        blk = lax.dynamic_slice(h, (off, 0), (rows[k], F))
+        blocks.append(enc(blk) if enc is not None else blk)
     recvs = [
         lax.ppermute(
             b, axis_name,
@@ -495,6 +601,8 @@ def _sched_rounds_rev(h, send_idx, send_mask, n_pad, axis_name, schedule,
         ra = schedule.rank_arrays(k)
         plane = jnp.asarray(ra["back_plane"], jnp.int32)[me]
         start = jnp.asarray(ra["send_start"], jnp.int32)[me]
+        if dec is not None:
+            recv = dec(recv)
         back = lax.dynamic_update_slice(back, recv[None], (plane, start, 0))
     back = back[:W] * send_mask[..., None].astype(back.dtype)
     flat_idx = send_idx.reshape(-1)
@@ -502,7 +610,8 @@ def _sched_rounds_rev(h, send_idx, send_mask, n_pad, axis_name, schedule,
 
 
 @functools.lru_cache(maxsize=None)
-def _make_sched_pair(axis_name, schedule, W, S, n_pad):
+def _make_sched_pair(axis_name, schedule, W, S, n_pad, wire_format="fp32",
+                     dtype_name="float32"):
     """The compiled-schedule exchange/unexchange custom-VJP pair — the
     exact mirror of :func:`_make_overlap_pair` with the per-delta rings
     swapped for the compiled rounds: the exchange's backward IS the
@@ -510,13 +619,13 @@ def _make_sched_pair(axis_name, schedule, W, S, n_pad):
     pinned explicitly so the transpose keeps the round schedule (and its
     op count, which the trace/HLO auditors pin per-round) instead of
     whatever JAX's default transpose would serialize. Cache key includes
-    the (frozen, hashable) schedule itself, so two plans with different
-    compiled schedules never share an executor."""
+    the (frozen, hashable) schedule itself plus the static wire format +
+    activation dtype, so two configurations never share an executor."""
 
     @jax.custom_vjp
     def exchange(x, send_idx, send_mask):
         return _sched_rounds_fwd(
-            x, send_idx, send_mask, axis_name, schedule, W, S)
+            x, send_idx, send_mask, axis_name, schedule, W, S, wire_format)
 
     def ex_fwd(x, send_idx, send_mask):
         return exchange(x, send_idx, send_mask), (send_idx, send_mask)
@@ -524,7 +633,8 @@ def _make_sched_pair(axis_name, schedule, W, S, n_pad):
     def ex_bwd(res, g):
         send_idx, send_mask = res
         dx = _sched_rounds_rev(
-            g, send_idx, send_mask, n_pad, axis_name, schedule, W, S)
+            g, send_idx, send_mask, n_pad, axis_name, schedule, W, S,
+            wire_format)
         return dx, None, None
 
     exchange.defvjp(ex_fwd, ex_bwd)
@@ -532,7 +642,8 @@ def _make_sched_pair(axis_name, schedule, W, S, n_pad):
     @jax.custom_vjp
     def unexchange(h, send_idx, send_mask):
         return _sched_rounds_rev(
-            h, send_idx, send_mask, n_pad, axis_name, schedule, W, S)
+            h, send_idx, send_mask, n_pad, axis_name, schedule, W, S,
+            wire_format)
 
     def un_fwd(h, send_idx, send_mask):
         return unexchange(h, send_idx, send_mask), (send_idx, send_mask)
@@ -540,7 +651,7 @@ def _make_sched_pair(axis_name, schedule, W, S, n_pad):
     def un_bwd(res, g):
         send_idx, send_mask = res
         dh = _sched_rounds_fwd(
-            g, send_idx, send_mask, axis_name, schedule, W, S)
+            g, send_idx, send_mask, axis_name, schedule, W, S, wire_format)
         return dh, None, None
 
     unexchange.defvjp(un_fwd, un_bwd)
@@ -553,6 +664,7 @@ def halo_exchange_sched(
     halo: HaloSpec,
     axis_name: Optional[str],
     schedule,
+    wire_format: str = "fp32",
 ) -> jax.Array:
     """:func:`halo_exchange` lowered as a compiled multi-round schedule
     (:mod:`dgraph_tpu.sched`): small pairs merged into shared ppermute
@@ -563,7 +675,8 @@ def halo_exchange_sched(
     W, S = halo.send_idx.shape[0], halo.s_pad
     if axis_name is None or schedule is None or not schedule.rounds:
         return halo_exchange(x, halo, axis_name, deltas=(), impl="none")
-    ex, _ = _make_sched_pair(axis_name, schedule, W, S, x.shape[0])
+    ex, _ = _make_sched_pair(axis_name, schedule, W, S, x.shape[0],
+                             wire_format, str(jnp.dtype(x.dtype)))
     return ex(x, halo.send_idx, halo.send_mask)
 
 
@@ -574,6 +687,7 @@ def halo_scatter_sum_sched(
     n_pad: int,
     axis_name: Optional[str],
     schedule,
+    wire_format: str = "fp32",
 ) -> jax.Array:
     """:func:`halo_scatter_sum` lowered as the compiled schedule's
     reverse replay (the sched pair's transpose) — same masked flat
@@ -583,7 +697,8 @@ def halo_scatter_sum_sched(
     if axis_name is None or schedule is None or not schedule.rounds:
         return halo_scatter_sum(h, halo, n_pad, axis_name, deltas=(),
                                 impl="none")
-    _, unex = _make_sched_pair(axis_name, schedule, W, S, n_pad)
+    _, unex = _make_sched_pair(axis_name, schedule, W, S, n_pad,
+                               wire_format, str(jnp.dtype(h.dtype)))
     return unex(h, halo.send_idx, halo.send_mask)
 
 
@@ -595,6 +710,7 @@ def halo_exchange(
     deltas: Optional[tuple] = None,
     impl: Optional[str] = None,
     schedule=None,
+    wire_format: Optional[str] = None,
 ) -> jax.Array:
     """Exchange boundary vertex features; returns the halo buffer.
 
@@ -625,9 +741,14 @@ def halo_exchange(
         — consulted only under ``impl='sched'``, where its absence is a
         loud error: the resolver only returns 'sched' when the plan
         carries a schedule, so a miss here means a caller bypassed it.
+      wire_format: the payload codec (dgraph_tpu.wire), already resolved
+        by the CALLER like ``impl`` (one resolution per call site — see
+        :func:`resolve_plan_wire_format`). None = 'fp32' identity, which
+        leaves every lowering's program literally unchanged.
     """
     F = x.shape[-1]
     W, S = halo.send_idx.shape[0], halo.s_pad
+    wf = wire_format or "fp32"
     if axis_name is not None and deltas is not None and len(deltas) == 0:
         # no live cross-rank traffic anywhere in the mesh (send_mask is
         # all-zero): the exchange is identically zero, so skip the padded
@@ -644,9 +765,9 @@ def halo_exchange(
         return send.reshape(-1, F)  # world size 1: mask is all-zero
     impl = _resolve_halo_arg(impl, deltas, W)
     if impl == "pallas_p2p":
-        return halo_exchange_p2p(x, halo, axis_name, tuple(deltas))
+        return halo_exchange_p2p(x, halo, axis_name, tuple(deltas), wf)
     if impl == "overlap":
-        return halo_exchange_overlap(x, halo, axis_name, tuple(deltas))
+        return halo_exchange_overlap(x, halo, axis_name, tuple(deltas), wf)
     if impl == "sched":
         if schedule is None:
             raise ValueError(
@@ -654,8 +775,10 @@ def halo_exchange(
                 "halo schedule; resolve through resolve_plan_impl and "
                 "pass schedule=plan.halo_schedule"
             )
-        return halo_exchange_sched(x, halo, axis_name, schedule)
+        return halo_exchange_sched(x, halo, axis_name, schedule, wf)
     if impl == "ppermute":
+        from dgraph_tpu.wire.codec import make_ppermute_codec
+
         me = lax.axis_index(axis_name)
         out = jnp.zeros((W * S, F), x.dtype)
         for d in deltas:
@@ -663,13 +786,27 @@ def halo_exchange(
             idx = jnp.take(halo.send_idx, peer_row, axis=0)
             msk = jnp.take(halo.send_mask, peer_row, axis=0)
             send = x[idx] * msk[..., None].astype(x.dtype)  # [S, F]
-            perm = [(i, (i + d) % W) for i in range(W)]
-            recv = lax.ppermute(send, axis_name, perm)
+            perm = tuple((i, (i + d) % W) for i in range(W))
+            # trip = decode(ppermute(encode(.))) wrapped in a custom VJP
+            # (the fp8 payload is uint8 — plain AD would drop the
+            # cotangent); None = identity format, plain ppermute
+            trip = make_ppermute_codec(axis_name, perm, wf,
+                                       str(jnp.dtype(x.dtype)))
+            if trip is None:
+                recv = lax.ppermute(send, axis_name, list(perm))
+            else:
+                recv = trip(send)
             src_rank = (me - d) % W
             out = lax.dynamic_update_slice(out, recv, (src_rank * S, 0))
         return out
+    from dgraph_tpu.wire.codec import make_a2a_codec
+
     send = x[halo.send_idx] * halo.send_mask[..., None].astype(x.dtype)
-    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0)
+    trip = make_a2a_codec(axis_name, wf, str(jnp.dtype(x.dtype)))
+    if trip is None:
+        recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0)
+    else:
+        recv = trip(send)
     return recv.reshape(-1, F)
 
 
@@ -682,6 +819,7 @@ def halo_scatter_sum(
     deltas: Optional[tuple] = None,
     impl: Optional[str] = None,
     schedule=None,
+    wire_format: Optional[str] = None,
 ) -> jax.Array:
     """Linear transpose of :func:`halo_exchange`: deliver halo-slot values
     back to their owner ranks and sum into local vertices.
@@ -694,10 +832,13 @@ def halo_scatter_sum(
       h: [W*S, F] halo-buffer values on this shard.
       impl: the lowering, resolved once by the caller (see
         :func:`resolve_plan_impl`); None resolves here.
+      wire_format: payload codec, resolved by the caller like ``impl``
+        (:func:`resolve_plan_wire_format`); None = fp32 identity.
     Returns: [n_pad, F] per-local-vertex sums.
     """
     W, S = halo.send_idx.shape[0], halo.s_pad
     F = h.shape[-1]
+    wf = wire_format or "fp32"
     if axis_name is not None and deltas is not None and len(deltas) == 0:
         # transpose of the empty exchange: no halo slot maps anywhere
         return jnp.zeros((n_pad, F), h.dtype)
@@ -705,10 +846,10 @@ def halo_scatter_sum(
         impl = _resolve_halo_arg(impl, deltas, W)
         if impl == "pallas_p2p":
             return halo_scatter_sum_p2p(h, halo, n_pad, axis_name,
-                                        tuple(deltas))
+                                        tuple(deltas), wf)
         if impl == "overlap":
             return halo_scatter_sum_overlap(h, halo, n_pad, axis_name,
-                                            tuple(deltas))
+                                            tuple(deltas), wf)
         if impl == "sched":
             if schedule is None:
                 raise ValueError(
@@ -717,8 +858,10 @@ def halo_scatter_sum(
                     "resolve_plan_impl and pass schedule=plan.halo_schedule"
                 )
             return halo_scatter_sum_sched(h, halo, n_pad, axis_name,
-                                          schedule)
+                                          schedule, wf)
         if impl == "ppermute":
+            from dgraph_tpu.wire.codec import make_ppermute_codec
+
             me = lax.axis_index(axis_name)
             out = jnp.zeros((n_pad, F), h.dtype)
             for d in deltas:
@@ -727,8 +870,13 @@ def halo_scatter_sum(
                 src_rank = (me - d) % W
                 block = lax.dynamic_slice(
                     h.reshape(W * S, F), (src_rank * S, 0), (S, F))
-                perm = [(i, (i - d) % W) for i in range(W)]
-                recv = lax.ppermute(block, axis_name, perm)  # from (me+d)
+                perm = tuple((i, (i - d) % W) for i in range(W))
+                trip = make_ppermute_codec(axis_name, perm, wf,
+                                           str(jnp.dtype(h.dtype)))
+                if trip is None:
+                    recv = lax.ppermute(block, axis_name, list(perm))
+                else:
+                    recv = trip(block)  # from (me+d)
                 peer_row = (me + d) % W
                 idx = jnp.take(halo.send_idx, peer_row, axis=0)
                 msk = jnp.take(halo.send_mask, peer_row, axis=0)
@@ -739,7 +887,16 @@ def halo_scatter_sum(
     if axis_name is None:
         back = h
     else:
-        back = lax.all_to_all(h, axis_name, split_axis=0, concat_axis=0)
+        from dgraph_tpu.wire.codec import make_a2a_codec
+
+        trip = make_a2a_codec(axis_name, wf, str(jnp.dtype(h.dtype)))
+        if trip is None:
+            back = lax.all_to_all(h, axis_name, split_axis=0, concat_axis=0)
+        else:
+            # cotangent rows ride the wire encoded UNMASKED (the mask
+            # applies after decode, below) — same ordering as every
+            # round-based reverse lowering, so wire bytes stay identical
+            back = trip(h)
     back = back * halo.send_mask[..., None].astype(back.dtype)
     flat_idx = halo.send_idx.reshape(-1)
     return local_ops.segment_sum(back.reshape(flat_idx.shape[0], -1), flat_idx, n_pad)
@@ -787,7 +944,9 @@ def halo_extend(
         impl = resolve_plan_impl(plan, axis_name)
     haloed = halo_exchange(x, plan.halo, axis_name, deltas=plan.halo_deltas,
                            impl=impl,
-                           schedule=getattr(plan, "halo_schedule", None))
+                           schedule=getattr(plan, "halo_schedule", None),
+                           wire_format=resolve_plan_wire_format(
+                               plan, axis_name))
     return jnp.concatenate([x, haloed], axis=0)
 
 
@@ -904,6 +1063,7 @@ def scatter_sum(
     return local_part + halo_scatter_sum(
         remote_part, plan.halo, n_pad, axis_name, deltas=plan.halo_deltas,
         impl=impl, schedule=getattr(plan, "halo_schedule", None),
+        wire_format=resolve_plan_wire_format(plan, axis_name),
     )
 
 
@@ -1089,7 +1249,8 @@ def _scatter_sum_split(edata, plan, side, axis_name, remote_fn):
         bnd_rows, ov.side("boundary", side), W * S, indices_are_sorted=False
     )
     remote = remote_fn(
-        slot_sums, plan.halo, n_pad, axis_name, tuple(plan.halo_deltas)
+        slot_sums, plan.halo, n_pad, axis_name, tuple(plan.halo_deltas),
+        resolve_plan_wire_format(plan, axis_name),
     )
     # interior leg while the transport is in flight
     int_rows = local_ops.take_rows(edata, ov.int_epos)

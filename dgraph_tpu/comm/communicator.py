@@ -61,20 +61,25 @@ class _BaseComm:
         raise NotImplementedError
 
     # -- the differentiable primitives (L5) --
-    def halo_exchange(self, x, halo: HaloSpec, deltas=None, impl=None):
-        """Exchange boundary features. ``deltas``/``impl`` (from the plan /
-        :func:`collectives.resolve_plan_impl`) select the lowering — resolve
-        once per call site and thread it, so one jitted step can never mix
-        lowerings (plan-less callers default to the padded all_to_all)."""
+    def halo_exchange(self, x, halo: HaloSpec, deltas=None, impl=None,
+                      wire_format=None):
+        """Exchange boundary features. ``deltas``/``impl``/``wire_format``
+        (from the plan / :func:`collectives.resolve_plan_impl` /
+        :func:`collectives.resolve_plan_wire_format`) select the lowering
+        and payload codec — resolve once per call site and thread them, so
+        one jitted step can never mix lowerings (plan-less callers default
+        to the padded all_to_all with the fp32 identity wire)."""
         return collectives.halo_exchange(
-            x, halo, self.graph_axis, deltas=deltas, impl=impl
+            x, halo, self.graph_axis, deltas=deltas, impl=impl,
+            wire_format=wire_format,
         )
 
     def halo_exchange_overlap(self, x, plan: EdgePlan):
         """The overlap lowering's exchange: double-buffered ppermute rounds
         whose [W*S, F] result the boundary takes index directly."""
         return collectives.halo_exchange_overlap(
-            x, plan.halo, self.graph_axis, tuple(plan.halo_deltas)
+            x, plan.halo, self.graph_axis, tuple(plan.halo_deltas),
+            collectives.resolve_plan_wire_format(plan, self.graph_axis),
         )
 
     def overlap_active(self, plan: EdgePlan) -> bool:

@@ -165,6 +165,21 @@ overlap_interior_chunks: int = int(
     os.environ.get("DGRAPH_TPU_OVERLAP_CHUNKS", "1")
 )
 
+# Wire codec for halo payloads (dgraph_tpu.wire): 'auto' (defer to the
+# adopted tuning record, then the plan-attached format, then the fp32
+# identity — a lossy codec never engages on its own), or an explicit
+# 'fp32' / 'bf16' / 'fp8' pin. Resolution precedence lives in
+# wire.spec.resolve_wire_format: this env pin > tuned_wire_format
+# (below) > EdgePlan.wire_format > 'fp32'; a pinned format whose
+# preconditions fail (fp8 without the e4m3 dtype) degrades with one
+# warning to the next tier.
+wire_format: str = os.environ.get("DGRAPH_TPU_WIRE_FORMAT", "auto")
+
+# Wire format chosen by an adopted TuningRecord: set by
+# tune.record.adopt_record, consulted by wire.spec.resolve_wire_format
+# AFTER the env pin. None = no record adopted.
+tuned_wire_format: str | None = None
+
 # Halo lowering chosen by an adopted TuningRecord (dgraph_tpu.tune):
 # set by tune.record.adopt_record, consulted by plan.resolve_halo_impl
 # AFTER the env pin — an operator's explicit DGRAPH_TPU_HALO_IMPL always

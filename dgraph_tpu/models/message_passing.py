@@ -92,11 +92,15 @@ class MessagePassing(nn.Module):
         # when the plan carries an interior/boundary split) and thread it
         # — the plan-less facade default would always pay the padded
         # all_to_all
-        from dgraph_tpu.comm.collectives import resolve_plan_impl
+        from dgraph_tpu.comm.collectives import (
+            resolve_plan_impl,
+            resolve_plan_wire_format,
+        )
 
         impl = resolve_plan_impl(plan, self.comm.graph_axis)
         halo = self.comm.halo_exchange(
-            x, plan.halo, deltas=plan.halo_deltas, impl=impl
+            x, plan.halo, deltas=plan.halo_deltas, impl=impl,
+            wire_format=resolve_plan_wire_format(plan, self.comm.graph_axis),
         )
         full = jnp.concatenate([x, halo], axis=0)
         return self.layer(full, plan)

@@ -93,10 +93,24 @@ def plan_footprint(
         resolve_halo_impl,
     )
 
+    from dgraph_tpu.wire.spec import get_format, resolve_wire_format
+
     W, S = plan.world_size, plan.halo.s_pad
     b = dtype_bytes(dtype)
     F = int(feat_dim)
     row_bytes = F * b
+    # wire rows are priced at the RESOLVED codec's encoded width (the
+    # same ladder the runtime walks: env pin > tuned record > plan-
+    # attached > fp32 identity); HBM-side quantities stay at the
+    # activation row_bytes — only the collective operand is encoded.
+    # With the fp32 identity wire_row_bytes == row_bytes and every
+    # number below reproduces the pre-codec report exactly.
+    wf_name, wf_source = resolve_wire_format(
+        W, tuple(plan.halo_deltas),
+        plan_format=getattr(plan, "wire_format", "fp32"),
+    )
+    wire_fmt = get_format(wf_name)
+    wire_row_bytes = wire_fmt.wire_row_bytes(F, b)
 
     send_mask = np.asarray(plan.halo.send_mask) > 0  # [W, W, S]
     real_counts = send_mask.sum(axis=2).astype(np.int64)  # [sender, needer]
@@ -119,13 +133,13 @@ def plan_footprint(
     # compiled schedule (dgraph_tpu.sched): per-round padded operand rows
     # C_k; every round is a ppermute, fully remote. () when unattached.
     sched_rows = schedule.round_rows() if schedule is not None else ()
-    sched_wire = sum(sched_rows) * row_bytes
+    sched_wire = sum(sched_rows) * wire_row_bytes
 
     # one halo_exchange (the gather's comm leg); halo_scatter_sum (the
     # scatter's reverse leg / the exchange's transpose) moves the same.
-    a2a_operand = W * S * row_bytes  # [W, S, F] per shard
-    a2a_ici = (W - 1) * S * row_bytes  # self block never leaves the chip
-    pp_operand = n_deltas * S * row_bytes  # one [S, F] per live delta
+    a2a_operand = W * S * wire_row_bytes  # [W, S, F_wire] per shard
+    a2a_ici = (W - 1) * S * wire_row_bytes  # self block never leaves chip
+    pp_operand = n_deltas * S * wire_row_bytes  # one [S, F_wire] per delta
     # the overlap lowering sends the same boundary-only round payloads as
     # ppermute — its win is SCHEDULING (exposed time), not wire bytes.
     # pallas_p2p moves the same boundary-only tiles as one-sided puts:
@@ -136,7 +150,7 @@ def plan_footprint(
         "pallas_p2p": pp_operand, "sched": sched_wire,
     }
     chosen_wire = wire_per_shard.get(impl, 0)
-    real_bytes = real_rows * row_bytes
+    real_bytes = real_rows * wire_row_bytes
     # analytic-min HBM streams per shard per exchange, LOWERING-AWARE:
     # the [W*S, F] halo output buffer is written either way, but only the
     # blocks the chosen lowering actually sends are gathered and read
@@ -171,6 +185,10 @@ def plan_footprint(
     exchange = {
         "impl": impl,
         "impl_source": impl_source,
+        "wire_format": wf_name,
+        "wire_format_source": wf_source,
+        "wire_row_bytes": wire_row_bytes,
+        "compression_ratio": round(wire_fmt.compression_ratio(F, b), 4),
         "operand_bytes_per_shard": operand_by_impl.get(impl, 0),
         "a2a_operand_bytes_per_shard": a2a_operand,
         "ici_bytes_per_shard": chosen_wire,
@@ -195,7 +213,9 @@ def plan_footprint(
         # interior-edge rows one exchange leg drives (take write, read,
         # reduce write — the per-leg half of search.py's 6-stream model).
         int_rows_max = max(edge_split["interior_per_shard"] or [0])
-        round_comm_us = (S * row_bytes) / (ici_gbps * 1e3) if ici_gbps else 0.0
+        round_comm_us = (
+            (S * wire_row_bytes) / (ici_gbps * 1e3) if ici_gbps else 0.0
+        )
         interior_us = (
             3 * int_rows_max * row_bytes / (hbm_gbps * 1e3) if hbm_gbps else 0.0
         )
@@ -222,7 +242,7 @@ def plan_footprint(
         p2p_exposed = n_deltas * max(round_comm_us, per_round_int)
         exchange["pallas_p2p"] = {
             "tiles": n_deltas,
-            "tile_bytes": S * row_bytes,
+            "tile_bytes": S * wire_row_bytes,
             "tile_dma_us": round(round_comm_us, 3),
             "tile_stage_us": round(tile_stage_us, 3),
             "interior_tile_us": round(per_round_int, 3),
@@ -250,7 +270,7 @@ def plan_footprint(
             3 * int_rows_max * row_bytes / (hbm_gbps * 1e3) if hbm_gbps
             else 0.0
         )
-        round_bytes = [int(c) * row_bytes for c in sched_rows]
+        round_bytes = [int(c) * wire_row_bytes for c in sched_rows]
         round_us = [
             (rb / (ici_gbps * 1e3) if ici_gbps else 0.0)
             for rb in round_bytes
@@ -302,8 +322,12 @@ def plan_footprint(
             "real_bytes_total": real_bytes,
             "per_shard_send_rows": [int(v) for v in send_rows],
             "per_shard_recv_rows": [int(v) for v in recv_rows],
-            "per_shard_send_bytes": [int(v) * row_bytes for v in send_rows],
-            "per_shard_recv_bytes": [int(v) * row_bytes for v in recv_rows],
+            "per_shard_send_bytes": [
+                int(v) * wire_row_bytes for v in send_rows
+            ],
+            "per_shard_recv_bytes": [
+                int(v) * wire_row_bytes for v in recv_rows
+            ],
             "wire_bytes_per_shard": wire_per_shard,
             "active_peer_pairs": int((real_counts > 0).sum()),
         },

@@ -86,6 +86,7 @@ ENTRY_KINDS = (
     "spmd_drift",        # fallback tier 4: cross-rank schedule identity
     "tune_record",       # tune_<sig>.json TuningRecord
     "sched_compile",     # compiled halo schedule: id, rounds, priced bytes
+    "wire_compile",      # resolved wire format: name, priced operand bytes
     "serve_health",      # serving latency/recompile/tenant record
     "supervise_lineage",        # single-child restart lineage
     "supervise_group_lineage",  # multi-rank group lineage
@@ -522,6 +523,28 @@ def _norm_sched_compile(obj: dict, source: str) -> tuple:
     )], []
 
 
+def _norm_wire_compile(obj: dict, source: str) -> tuple:
+    """wire_compile: one resolved wire format (dgraph_tpu.wire) with its
+    priced exchange operand. ``operand_bytes`` rides obs.regress's
+    byte-exact zero-tolerance class: a codec or pricing change that
+    alters what the same workload ships on the wire goes RED across
+    commits. The format name, who resolved it, and the compression ratio
+    are provenance (meta), not gated numbers."""
+    metrics = {
+        "operand_bytes": obj.get("operand_bytes"),
+    }
+    return [_entry(
+        "wire_compile", metrics,
+        workload=_workload_tag(obj.get("workload")),
+        halo_impl=obj.get("halo_impl"),
+        git_rev=obj.get("git_rev"), recorded_at=obj.get("recorded_at"),
+        source=source, round_n=obj.get("round"),
+        meta={"wire_format": obj.get("wire_format"),
+              "wire_format_source": obj.get("wire_format_source"),
+              "compression_ratio": obj.get("compression_ratio")},
+    )], []
+
+
 def _norm_run_health(obj: dict, source: str) -> tuple:
     metrics = {"wall_s": obj.get("wall_s"),
                "n_probes": len(obj.get("probes") or [])}
@@ -583,6 +606,8 @@ def normalize_record(obj, source: str = "") -> tuple:
             return _norm_run_health(obj, source)
         if kind == "sched_compile":
             return _norm_sched_compile(obj, source)
+        if kind == "wire_compile":
+            return _norm_wire_compile(obj, source)
         if kind == "tune_record" or (
             kind is None and "record_id" in obj and "signature" in obj
             and "cost" in obj

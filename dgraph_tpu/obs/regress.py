@@ -28,11 +28,12 @@ format — ``utils.logging.ExperimentLog`` itself imports jax, which this
 module may not: it is jax-free by the same lint-enforced contract as the
 ledger, and runs on a machine where jax is wedged or absent).
 
-``--selftest`` seeds a synthetic trajectory and four drifted mutants
-(inflated wire bytes, slowed scan-delta, fattened p99, dropped tier) —
-each must go RED, and the clean trajectory must stay GREEN, or the
-selftest itself fails (the vacuity guard: a sentinel that can't see
-seeded drift gates nothing).
+``--selftest`` seeds a synthetic trajectory and six drifted mutants
+(inflated wire bytes, slowed scan-delta, fattened p99, dropped tier,
+drifted compiled schedule, drifted wire-format bytes) — each must go
+RED, and the clean trajectory must stay GREEN, or the selftest itself
+fails (the vacuity guard: a sentinel that can't see seeded drift gates
+nothing).
 """
 
 from __future__ import annotations
@@ -319,15 +320,31 @@ def _fx_sched(i: int, *, operand_bytes: int = 2048, rounds: int = 3) -> dict:
     }
 
 
+def _fx_wire(i: int, *, operand_bytes: int = 1024) -> dict:
+    """One resolved-wire-format record (dgraph_tpu.wire -> obs.ledger
+    ``wire_compile``). ``operand_bytes`` carries the exact-class suffix,
+    so the mutant's +64 bytes must go RED with zero tolerance."""
+    return {
+        "kind": "wire_compile",
+        "workload": {"world_size": 2, "nodes": 96, "edges": 400,
+                     "feat_dim": 8, "seed": 0},
+        "wire_format": "bf16", "wire_format_source": "tune",
+        "operand_bytes": operand_bytes, "compression_ratio": 2.0,
+        "git_rev": f"rev{i:04d}",
+        "recorded_at": f"2026-08-01T03:{i:02d}:00Z",
+    }
+
+
 def _seed(tmp: str, n: int = 6) -> None:
     for i in range(n):
         ingest(_fx_round(i), f"fixture_r{i:02d}", tmp)
         ingest(_fx_serve(i), f"fixture_serve_r{i:02d}", tmp)
         ingest(_fx_sched(i), f"fixture_sched_r{i:02d}", tmp)
+        ingest(_fx_wire(i), f"fixture_wire_r{i:02d}", tmp)
 
 
 def _selftest() -> dict:
-    """Clean trajectory GREEN + four seeded-drift mutants each RED."""
+    """Clean trajectory GREEN + the seeded-drift mutants each RED."""
     import tempfile
 
     failures: list = []
@@ -384,6 +401,14 @@ def _selftest() -> dict:
         "drifted_schedule": (
             lambda tmp: ingest(_fx_sched(6, operand_bytes=2048 + 64),
                                "fixture_sched_r06", tmp),
+            "operand_bytes",
+        ),
+        # 6. drifted wire bytes: +64 priced operand bytes for the same
+        # workload at the same format — a codec/pricing change altering
+        # what ships on the wire must hit the byte-exact class too
+        "drifted_wire_bytes": (
+            lambda tmp: ingest(_fx_wire(6, operand_bytes=1024 + 64),
+                               "fixture_wire_r06", tmp),
             "operand_bytes",
         ),
     }

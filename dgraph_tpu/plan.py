@@ -359,6 +359,7 @@ class OverlapSpec:
         "gather_mv",
         "halo_pair_rows",
         "halo_schedule",
+        "wire_format",
     )
 )
 class EdgePlan:
@@ -450,6 +451,14 @@ class EdgePlan:
     # halo_impl="sched"; None when no cross-rank traffic (or on plans
     # predating the compiler).
     halo_schedule: Any = None
+    # Wire format name (dgraph_tpu.wire.spec.WIRE_FORMATS) attached
+    # deterministically at plan build — the build-time resolution of the
+    # adoption ladder, so a cache round-trip keeps an adopted codec.
+    # Runtime resolution (wire.spec.resolve_wire_format) still lets an
+    # env pin or a freshly adopted record override it. "fp32" (the
+    # identity) on plans predating the codec layer (stale caches rebuild
+    # via PLAN_FORMAT_VERSION).
+    wire_format: str = "fp32"
 
     def ids_sorted(self, side: str) -> bool:
         """True iff this side's per-edge index is monotone: the OWNER side
@@ -469,6 +478,8 @@ def dtype_nbytes(dtype) -> int:
     name = getattr(dtype, "__name__", None) or str(dtype)
     if name in ("bfloat16", "bf16"):
         return 2
+    if name in ("float8_e4m3fn", "fp8", "f8E4M3FN"):
+        return 1
     return int(np.dtype(name).itemsize)
 
 
@@ -598,6 +609,29 @@ def compile_plan_schedule(
     return compile_halo_schedule(
         pair_rows, s_pad=int(s_pad), world_size=int(world_size)
     )
+
+
+def plan_wire_format(world_size: int, halo_deltas: tuple) -> str:
+    """The ONE attach rule for a plan's wire format
+    (:mod:`dgraph_tpu.wire`): both plan-build paths
+    (:func:`_finalize_plan`) and the shard assembler
+    (:func:`assemble_plan`, for pre-codec manifests) stamp through here,
+    so a monolithic build and a cache round-trip of the same graph under
+    the same adoption state carry the identical format. This is the
+    build-time pass of the adoption ladder WITHOUT a plan tier (the plan
+    is being built): env pin > adopted tuning record > the fp32
+    identity. Runtime consumers re-resolve through
+    :func:`dgraph_tpu.wire.spec.resolve_wire_format` with this value as
+    the plan tier, so a later env pin or record adoption still wins.
+    """
+    if not halo_deltas:
+        return "fp32"
+    from dgraph_tpu.wire.spec import resolve_wire_format
+
+    name, _source = resolve_wire_format(
+        int(world_size), tuple(halo_deltas), plan_format="fp32"
+    )
+    return name
 
 
 def resolve_halo_impl(
@@ -1442,6 +1476,7 @@ def _finalize_plan(
         overlap=overlap_spec,
         halo_pair_rows=halo_pair_rows,
         halo_schedule=halo_schedule,
+        wire_format=plan_wire_format(W, halo_deltas),
     )
     layout = EdgePlanLayout(
         edge_rank=edge_rank,
@@ -1679,6 +1714,10 @@ def _shard_statics(prep, *, homogeneous, edge_owner, sort_edges, sort_route,
         "halo_pair_rows": [
             [int(v) for v in row] for row in np.asarray(prep.halo_counts)
         ],
+        # build-time wire-format resolution (same ONE attach rule as the
+        # monolithic path), stamped so a cache round-trip keeps an
+        # adopted codec even if the loading process has no record
+        "wire_format": plan_wire_format(prep.W, tuple(prep.halo_deltas)),
     }
     if overlap:
         # subset pads are global maxima over ranks — computable from the
@@ -2088,6 +2127,12 @@ def assemble_plan(manifest: dict, payloads: dict, ranks: list) -> EdgePlan:
             pair_rows, s_pad=int(st["s_pad"]),
             world_size=int(st["world_size"]),
             halo_deltas=tuple(int(d) for d in st["halo_deltas"]),
+        ),
+        # stamped manifests carry their build-time resolution; pre-codec
+        # manifests (no key) re-resolve through the same ONE attach rule
+        wire_format=st.get("wire_format") or plan_wire_format(
+            int(st["world_size"]),
+            tuple(int(d) for d in st["halo_deltas"]),
         ),
     )
 

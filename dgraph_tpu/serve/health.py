@@ -45,6 +45,24 @@ def _tenant_section(batcher, snap: dict) -> Optional[dict]:
     return out
 
 
+def _wire_provenance(plan) -> Optional[dict]:
+    """The wire format the engine's halo payloads ship with, plus who
+    resolved it (env > record > plan > fp32 default) — pure static-aux
+    reads, so a health snapshot never touches a device buffer."""
+    if plan is None:
+        return None
+    try:
+        from dgraph_tpu.wire.spec import resolve_wire_format
+
+        name, source = resolve_wire_format(
+            int(plan.world_size), tuple(plan.halo_deltas),
+            plan_format=getattr(plan, "wire_format", "fp32"),
+        )
+        return {"format": name, "source": source}
+    except Exception:  # provenance must never break a health snapshot
+        return None
+
+
 def serve_health_record(
     engine, batcher=None, *, registry: Optional[Metrics] = None
 ) -> dict:
@@ -81,6 +99,9 @@ def serve_health_record(
         # the adopted tuning record (dgraph_tpu.tune) these latency numbers
         # were produced under, or None for the hard-coded defaults
         "tuning_record": getattr(engine, "tuning_record_id", None),
+        # the wire codec the halo payloads ship with and who resolved it
+        # (dgraph_tpu.wire) — same attribution discipline as the record
+        "wire_format": _wire_provenance(getattr(engine, "_plan", None)),
         # control-plane provenance: checkpoint-rollover lineage (every
         # swap_params attempt, adopted or rolled back) and the adopted
         # graph-delta generation (dgraph_tpu.serve.deltas), so a latency

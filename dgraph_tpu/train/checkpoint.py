@@ -222,7 +222,11 @@ def checkpoint_keys(ckpt_dir: str, step: Optional[int] = None):
 # Bump whenever EdgePlan's fields/defaults change shape or meaning: stale
 # cache pickles must REBUILD, not silently inherit new class defaults for
 # fields they were never built with (e.g. scatter_block_e).
-PLAN_FORMAT_VERSION = 9  # v9: halo_pair_rows traffic matrix + compiled
+PLAN_FORMAT_VERSION = 10  # v10: wire_format static (dgraph_tpu.wire) —
+# the adopted halo-payload codec rides EdgePlan statics + the sharded
+# manifest, so cached plans predating the codec layer must rebuild and
+# stamp their build-time resolution;
+# v9: halo_pair_rows traffic matrix + compiled
 # halo_schedule statics (dgraph_tpu.sched) — cached plans predating the
 # schedule compiler must rebuild so the matrix lands in the manifest;
 # v8: sharded plan artifacts — per-rank

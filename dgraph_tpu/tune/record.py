@@ -46,6 +46,7 @@ _BUILD_KEYS = ("partition_method", "pad_multiple")
 _KNOWN_CONFIG_KEYS = _BUILD_KEYS + (
     "edge_owner",
     "halo_impl",
+    "wire_format",
     "use_pallas_scatter",
     "scatter_block_e",
     "scatter_block_n",
@@ -123,6 +124,15 @@ class TuningRecord:
                 "sched",
             ):
                 errors.append(f"halo_impl {impl!r} unknown")
+            wf = self.config.get("wire_format")
+            if wf is not None:
+                from dgraph_tpu.wire.spec import WIRE_FORMAT_NAMES
+
+                if wf not in WIRE_FORMAT_NAMES:
+                    errors.append(
+                        f"wire_format {wf!r} unknown "
+                        f"(known: {WIRE_FORMAT_NAMES})"
+                    )
             serve = self.config.get("serve")
             if serve is not None:
                 # the serve CLI indexes these directly; a partial dict must
@@ -230,13 +240,16 @@ def clear_adoption() -> None:
     """Reset the process-global tuned flags to the no-record state.
 
     Adoption state is process-global (``config.tuned_halo_impl`` /
-    ``config.tuning_record_id``); a consumer that looked up a record and
-    found NONE must call this so a previously adopted graph's halo
-    lowering cannot silently leak onto an untuned one built later in the
-    same process."""
+    ``config.tuned_wire_format`` / ``config.tuning_record_id``); a
+    consumer that looked up a record and found NONE must call this so a
+    previously adopted graph's halo lowering (or wire codec) cannot
+    silently leak onto an untuned one built later in the same
+    process."""
     from dgraph_tpu import config as _cfg
 
-    _cfg.set_flags(tuned_halo_impl=None, tuning_record_id=None)
+    _cfg.set_flags(
+        tuned_halo_impl=None, tuned_wire_format=None, tuning_record_id=None
+    )
 
 
 def adopt_record(rec: TuningRecord) -> dict:
@@ -257,6 +270,13 @@ def adopt_record(rec: TuningRecord) -> dict:
         tuned_halo_impl=impl
         if impl in ("ppermute", "all_to_all", "overlap", "pallas_p2p", "sched")
         else None
+    )
+    # the tuned wire format rides the 'record' tier of wire.spec.
+    # resolve_wire_format; an fp32 winner clears the flag (identity is
+    # the default, not an adoption)
+    wf = rec.config.get("wire_format")
+    _cfg.set_flags(
+        tuned_wire_format=wf if wf not in (None, "fp32") else None
     )
     _cfg.set_flags(tuning_record_id=rec.record_id)
     _logger.info(

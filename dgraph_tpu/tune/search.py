@@ -173,6 +173,67 @@ def candidate_cost(
         )
         exch_us = bounds[impl]
 
+    # wire-format ranking (dgraph_tpu.wire): the codec changes only the
+    # WIRE leg of the chosen lowering — decode accumulates at the
+    # activation dtype, so HBM streams, launches and local work are
+    # format-invariant. Re-price the winner's exchange bound with each
+    # registered format's row width and keep the min; the ordering
+    # tie-break prefers the less lossy format (fp32 first), so a lossy
+    # codec never engages without STRICTLY beating the lossless wire —
+    # e.g. an HBM-bound exchange ties every format and fp32 stands.
+    from dgraph_tpu.wire.spec import (
+        WIRE_FORMAT_NAMES,
+        fp8_available,
+        get_format,
+    )
+
+    exch_rep = fp["collectives"]["halo_exchange"]
+    res_row = exch_rep["wire_row_bytes"]
+    wire_rank: dict = {}
+    wf_winner = "fp32"
+    wire_operand_bytes = 0
+    if n_d and res_row:
+        launches_by = {
+            "all_to_all": 1, "ppermute": n_d, "overlap": n_d,
+            "pallas_p2p": 1,
+            "sched": sched_fp["rounds"] if sched_fp else 0,
+        }
+        sent_by = {
+            "all_to_all": W, "ppermute": n_d, "overlap": n_d,
+            "pallas_p2p": n_d,
+            "sched": sched_fp["rounds"] if sched_fp else 0,
+        }
+
+        def _bound_at_wire_scale(scale: float) -> float:
+            wire_us = (
+                wire.get(impl, 0) * scale / (ici_gbps * 1e3)
+                + launches_by[impl] * LAUNCH_US
+            )
+            hbm_us = (2 * sent_by[impl] + W) * S * row / (hbm_gbps * 1e3)
+            bound = max(wire_us, hbm_us)
+            if impl in ("overlap", "pallas_p2p", "sched"):
+                bound = max(bound - interior_leg_us, 0.0)
+            return bound
+
+        names = [
+            n for n in WIRE_FORMAT_NAMES
+            if n != "fp8" or fp8_available()
+        ]
+        b_act = dtype_bytes(dtype)
+        for name in names:
+            row_f = get_format(name).wire_row_bytes(feat_dim, b_act)
+            wire_rank[name] = round(_bound_at_wire_scale(row_f / res_row), 3)
+        wf_winner = min(
+            names, key=lambda n: (wire_rank[n], names.index(n))
+        )
+        # byte-exact operand figure at the winner's width: the resolved
+        # operand is rows * res_row, so recover rows first (exact) and
+        # re-multiply — the wire_compile ledger gate is zero-tolerance
+        rows = exch_rep["operand_bytes_per_shard"] // res_row
+        wire_operand_bytes = rows * get_format(wf_winner).wire_row_bytes(
+            feat_dim, b_act
+        )
+
     local_us = 6 * (plan.e_pad + plan.n_dst_pad) * row / (hbm_gbps * 1e3)
     return {
         "total_us": round(2 * exch_us + local_us, 3),
@@ -197,6 +258,16 @@ def candidate_cost(
         "sched_schedule_id": sched_fp["schedule_id"] if sched_fp else None,
         "sched_operand_bytes": (
             int(sched_fp["operand_bytes_per_shard"]) if sched_fp else 0
+        ),
+        # wire-format ranking: every priced alternative lands in the
+        # trace (auditable); the winner is what the record adopts
+        "wire_format": wf_winner,
+        "wire_formats_us": wire_rank,
+        "wire_operand_bytes": int(wire_operand_bytes),
+        "wire_compression_ratio": round(
+            get_format(wf_winner).compression_ratio(
+                feat_dim, dtype_bytes(dtype)
+            ), 4,
         ),
         "interior_frac": split["interior_frac"],
         "boundary_frac": split["boundary_frac"],
@@ -439,6 +510,7 @@ def search(
         "pad_multiple": int(winner_cand.pad_multiple),
         "edge_owner": "dst",
         "halo_impl": winner_cost["halo_impl"],
+        "wire_format": winner_cost.get("wire_format", "fp32"),
         "serve": choose_ladder(min(max_request, num_nodes)),
     }
     config.update(_pallas_config(dtype, feat_dim, sweep_log))
@@ -477,6 +549,28 @@ def search(
                 "rounds": winner_cost["sched_rounds"],
                 "operand_bytes_per_shard": winner_cost["sched_operand_bytes"],
                 "exposed_us": winner_cost["sched_exposed_us"],
+            },
+            source="tune.search", default_on=False,
+        )
+    if winner_cost.get("wire_operand_bytes"):
+        # the winner's wire format joins the perf ledger the same way:
+        # operand_bytes lands in regress's byte-exact class, so a codec
+        # or pricing change that alters what this workload ships on the
+        # wire goes RED across commits
+        from dgraph_tpu.obs.ledger import maybe_ingest
+
+        maybe_ingest(
+            {
+                "kind": "wire_compile",
+                "workload": {
+                    "world_size": world_size, "nodes": num_nodes,
+                    "edges": int(edge_index.shape[1]),
+                    "feat_dim": feat_dim,
+                },
+                "wire_format": winner_cost["wire_format"],
+                "wire_format_source": "tune",
+                "operand_bytes": winner_cost["wire_operand_bytes"],
+                "compression_ratio": winner_cost["wire_compression_ratio"],
             },
             source="tune.search", default_on=False,
         )

@@ -76,13 +76,20 @@ PASSES = [
     # transfer must each go RED) — pure stdlib, zero XLA compiles
     ("sched-selftest",
      [sys.executable, "-m", "dgraph_tpu.sched", "--selftest", "true"]),
-    # perf-trajectory drift sentinel: the four seeded-drift vacuity
+    # perf-trajectory drift sentinel: the six seeded-drift vacuity
     # mutants (inflated wire bytes, slowed scan-delta, fattened p99,
-    # dropped fallback tier) must each go RED and the clean fixture
-    # ledger must gate GREEN — pure stdlib, zero compiles
+    # dropped fallback tier, drifted schedule, drifted wire-format
+    # bytes) must each go RED and the clean fixture ledger must gate
+    # GREEN — pure stdlib, zero compiles
     ("regress-selftest",
      [sys.executable, "-m", "dgraph_tpu.obs.regress",
       "--selftest", "true"]),
+    # wire codec layer: registry byte pins, numpy round-trip bounds per
+    # format, the wrong-scale/dropped-row vacuity mutants, the resolver
+    # ladder, the hub-dedup plan fixtures, and the jax-free guard —
+    # pure stdlib + numpy, zero compiles
+    ("wire-selftest",
+     [sys.executable, "-m", "dgraph_tpu.wire", "--selftest", "true"]),
 ]
 
 EXTRA_SELFTESTS = [
