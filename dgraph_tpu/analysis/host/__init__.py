@@ -121,6 +121,7 @@ HOST_SCOPE = (
     "dgraph_tpu/comm/membership.py",
     "dgraph_tpu/train/supervise.py",
     "dgraph_tpu/train/shrink.py",
+    "dgraph_tpu/train/grow.py",
     "dgraph_tpu/train/elastic.py",
     "dgraph_tpu/plan_shards.py",
     "dgraph_tpu/chaos/",

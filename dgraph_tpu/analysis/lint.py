@@ -211,6 +211,12 @@ JAX_FREE_TARGETS = (
     # comm/collectives.py replays the schedule and is the ONE jax
     # consumer, deliberately outside this list.
     "dgraph_tpu/sched/",
+    # the grow-to-fit transition: the world-growth decision path (join
+    # discovery, unfold, gather, adopt) must keep working while jax is
+    # wedged — everything that pulls jax (plan builder, reshard kernel)
+    # is reached through train/shrink.py's function-scope imports, and
+    # the join announcement path rides membership.py (already a target)
+    "dgraph_tpu/train/grow.py",
     # the wire-format registry, dedup planner, and their selftest: wire
     # formats are DATA (resolved, priced, serialized into plans and
     # tuning records) on the same backend-less hosts as the schedule

@@ -109,6 +109,18 @@ KNOWN_POINTS = {
                       "each lease write (index=seq)",
     "comm.rendezvous": "comm/membership.py::Membership.rendezvous, per "
                        "join attempt (index=attempt)",
+    # grow-to-fit world expansion (train/grow.py + the membership join
+    # rendezvous): a 'sigterm' on comm.join is a joiner preempted
+    # mid-announcement, on grow.replan a recovery killed before any new
+    # artifact exists, on grow.adopt the torn-window injection — killed
+    # after every new-generation artifact is durable but before the
+    # world.json pointer flips (old world must stay cleanly adoptable)
+    "comm.join": "comm/membership.py::Joiner.announce, before each "
+                 "join-lease write (index=seq)",
+    "grow.replan": "train/grow.py::grow_world at recovery entry, before "
+                   "any new-generation artifact is written",
+    "grow.adopt": "train/grow.py::grow_world at the commit boundary — "
+                  "artifacts durable, pointer flip still pending",
     # serving control plane (serve/rollover.py + serve/deltas.py): a
     # 'raise' on serve.swap proves rollback-to-prior-params with zero
     # dropped in-flight requests; a 'sigterm' on serve.replan (fired at

@@ -148,7 +148,7 @@ def _selftest() -> dict:
 
         # --- membership points (comm/membership.py): registered, parseable,
         # firing like any host boundary ---
-        for pt in ("comm.heartbeat", "comm.rendezvous"):
+        for pt in ("comm.heartbeat", "comm.rendezvous", "comm.join"):
             _check(
                 failures, pt in chaos.KNOWN_POINTS,
                 f"membership point {pt!r} missing from KNOWN_POINTS",
@@ -158,6 +158,33 @@ def _selftest() -> dict:
                 failures, cl.point == pt and cl.action == "raise",
                 f"membership point clause misparsed: {cl}",
             )
+
+        # --- grow-to-fit points (train/grow.py): registered, parseable,
+        # firing like any host boundary.  grow.adopt is consulted ONCE per
+        # transition at the commit boundary (artifacts durable, pointer
+        # flip pending), so sigterm@0 is the torn-window injection —
+        # prove index-0 gating fires exactly on the first consult ---
+        for pt in ("grow.replan", "grow.adopt"):
+            _check(
+                failures, pt in chaos.KNOWN_POINTS,
+                f"grow point {pt!r} missing from KNOWN_POINTS",
+            )
+            (cl,) = chaos.parse_spec(f"{pt}=sigterm@0")
+            _check(
+                failures, cl.point == pt and cl.action == "sigterm",
+                f"grow point clause misparsed: {cl}",
+            )
+        chaos.arm("grow.adopt=raise@0")
+        adopt_fired = []
+        for i in range(3):
+            try:
+                chaos.fire("grow.adopt")
+            except chaos.ChaosFault:
+                adopt_fired.append(i)
+        _check(
+            failures, adopt_fired == [0],
+            f"grow.adopt fired at {adopt_fired}, want [0]",
+        )
 
         # --- delay action: seeded sleep-jitter (straggler injection) ---
         (cl,) = chaos.parse_spec("comm.heartbeat=delay@0:count=4:seed=3")

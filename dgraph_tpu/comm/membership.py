@@ -71,6 +71,11 @@ from dgraph_tpu.utils.env import RANK_ENV_VAR
 # (the membership analog of train.elastic.WEDGED_EXIT_CODE == 17)
 RANK_LOST_EXIT_CODE = 19
 
+# a member that observed a join request exits with this code after saving
+# its checkpoint; supervise_group treats it as "grow the world and resume"
+# (the arrival mirror of RANK_LOST_EXIT_CODE)
+RANK_JOIN_EXIT_CODE = 23
+
 
 def rank_from_env(default: Optional[int] = None) -> int:
     """The member ordinal ``supervise_group`` exported to this process
@@ -91,6 +96,8 @@ def rank_from_env(default: Optional[int] = None) -> int:
 
 _MEMBER_PREFIX = "member_"
 _LEFT_PREFIX = "left_"
+_JOIN_PREFIX = "join_"
+_GRANT_PREFIX = "grant_"
 _BARRIER_DIR = "barriers"
 
 
@@ -163,6 +170,26 @@ class Straggler:
         }
 
 
+@dataclasses.dataclass(frozen=True)
+class JoinRequest:
+    """A prospective member announced itself into this generation (a
+    ``join_<token>`` lease appeared).  Emitted ONCE per token; the
+    observer should land a durable checkpoint and exit
+    :data:`RANK_JOIN_EXIT_CODE` so the group supervisor runs the
+    grow-to-fit transition (:mod:`dgraph_tpu.train.grow`)."""
+
+    kind = "join_request"
+    token: str
+    generation: int
+
+    def record(self) -> dict:
+        return {
+            "kind": self.kind,
+            "token": self.token,
+            "generation": self.generation,
+        }
+
+
 class RankLostError(RuntimeError):
     """Raised by callers (e.g. ``run_elastic(membership=...)``) once loss
     is detected and the local checkpoint is durable — the process should
@@ -181,6 +208,30 @@ class RankLostError(RuntimeError):
             "kind": "rank_lost_exit",
             "lost_ranks": list(self.lost_ranks),
             "exit_code": RANK_LOST_EXIT_CODE,
+            "events": [e.record() for e in self.events],
+        }
+
+
+class RankJoinError(RuntimeError):
+    """Raised by callers (e.g. ``run_elastic(membership=...)``) once a
+    join request is observed and the local checkpoint is durable — the
+    process should exit :data:`RANK_JOIN_EXIT_CODE` so the group
+    supervisor grows the world (the arrival mirror of
+    :class:`RankLostError`)."""
+
+    def __init__(self, tokens: tuple, events: tuple = ()):
+        super().__init__(
+            f"join request(s) {sorted(tokens)} observed; exit "
+            f"{RANK_JOIN_EXIT_CODE} for grow-to-fit restart"
+        )
+        self.tokens = tuple(sorted(tokens))
+        self.events = tuple(events)
+
+    def record(self) -> dict:
+        return {
+            "kind": "rank_join_exit",
+            "tokens": list(self.tokens),
+            "exit_code": RANK_JOIN_EXIT_CODE,
             "events": [e.record() for e in self.events],
         }
 
@@ -318,6 +369,7 @@ class Membership:
         self._hb_stop: Optional[threading.Event] = None
         self._hb_thread: Optional[threading.Thread] = None
         self._view: dict = {}  # rank -> _PeerView
+        self._join_view: dict = {}  # join token -> _PeerView
         self.events: list = []  # every event record, in emit order
         os.makedirs(self.dir, exist_ok=True)
 
@@ -432,6 +484,15 @@ class Membership:
         """Sorted ranks whose lease has expired."""
         return tuple(sorted(r for r, v in self._view.items() if v.lost))
 
+    def pending_joins(self) -> tuple:
+        """Sorted join tokens announced into this generation and still
+        fresh (announcement lease not expired on this observer's
+        clock)."""
+        return tuple(sorted(
+            t for t, v in self._join_view.items()
+            if v.seen and not v.lost
+        ))
+
     def poll(self) -> list:
         """Read peers' leases and update the liveness view; returns the
         NEW events this poll produced (:class:`RankLost`,
@@ -482,6 +543,39 @@ class Membership:
                 events.append(Straggler(
                     rank=r, silent_for_s=age, generation=self.generation,
                 ))
+        # join announcements (grow-to-fit arrivals). Newcomers are judged
+        # from FIRST-OBSERVED seq on this observer's clock: an observer
+        # whose polling history predates the newcomer's first write must
+        # never count that pre-arrival silence against it (the announce
+        # file's wall time is diagnostic only, and the _PeerView default
+        # last_change=0.0 would age an hours-old observer's first sight
+        # of a fresh joiner straight past the lease). A token silent past
+        # lease_s AFTER first observation expires quietly — a withdrawn
+        # join request is a non-event, not a RankLost.
+        for token, rec in sorted(_read_join_files(
+            self.dir, self.generation
+        ).items()):
+            v = self._join_view.setdefault(token, _PeerView())
+            seq = int(rec.get("seq", 0))
+            if v.lost:
+                # unlike a member's lease, join expiry is NOT terminal: a
+                # stalled joiner (GC pause, swapped host) that resumes
+                # announcing is a fresh rendezvous attempt, re-reported —
+                # only the SAME stale seq stays withdrawn
+                if seq == v.seq:
+                    continue
+                self._join_view[token] = v = _PeerView()
+            if not v.seen or seq != v.seq:
+                if not v.seen:
+                    events.append(JoinRequest(
+                        token=token, generation=self.generation,
+                    ))
+                v.seq = seq
+                v.last_change = now
+                v.seen = True
+                continue
+            if now - v.last_change > self.lease_s:
+                v.lost = True
         if joined or changed_lost or changed_left:
             events.append(MembershipChanged(
                 generation=self.generation,
@@ -682,14 +776,208 @@ class Membership:
                 self._sleep(poll_interval_s)
 
 
+def _read_join_files(directory: str, generation: Optional[int]) -> dict:
+    """token -> join record for every readable ``join_<token>.json`` in
+    ``directory`` (filtered to ``generation`` unless None)."""
+    out = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith(_JOIN_PREFIX) and name.endswith(".json"):
+            rec = _read_json(os.path.join(directory, name))
+            if rec is None or "token" not in rec:
+                continue
+            if generation is not None and rec.get("generation", 0) != generation:
+                continue
+            out[str(rec["token"])] = rec
+    return out
+
+
+def read_joins(directory: str, generation: Optional[int] = None) -> dict:
+    """token -> join record for the pending join announcements in a
+    membership directory (the grow path's discovery probe — see
+    :func:`dgraph_tpu.train.grow.grow_world`).  Read-only; filtered to
+    ``generation`` when given."""
+    return _read_join_files(directory, generation)
+
+
+def grant_join(
+    directory: str, token: str, *, rank: int, generation: int,
+    world_size: int,
+) -> dict:
+    """Answer a join announcement: durably publish the rank assignment a
+    :class:`Joiner` polling ``directory`` is waiting on.  Written by the
+    group supervisor AFTER the grow transition's ``world.json`` flip
+    (the grant names a generation, so it must never precede the pointer
+    that defines it)."""
+    rec = {
+        "token": str(token),
+        "rank": int(rank),
+        "generation": int(generation),
+        "world_size": int(world_size),
+        "wall": time.time(),  # diagnostic only, never compared
+    }
+    os.makedirs(directory, exist_ok=True)
+    _atomic_write_json(
+        os.path.join(directory, f"{_GRANT_PREFIX}{token}.json"), rec
+    )
+    return rec
+
+
+class Joiner:
+    """A prospective member's half of the grow-to-fit rendezvous: it
+    announces itself into a LIVE generation's membership directory and
+    waits for the supervisor's grant naming its rank in the grown world.
+
+    Usage (one instance per joining process)::
+
+        j = Joiner(membership_dir, token="node-b7", generation=g)
+        grant = j.join(deadline_s=120.0)   # announce + wait for grant
+        # grant == {"token", "rank", "generation", "world_size", ...}
+
+    The announcement is a lease like a member's (seq-advancing, written
+    atomically): live members observe it at their next poll
+    (:class:`JoinRequest`), checkpoint, and exit
+    :data:`RANK_JOIN_EXIT_CODE`; the supervisor re-plans to W+k
+    (:mod:`dgraph_tpu.train.grow`) and answers with
+    :func:`grant_join`.  A joiner that stops announcing before a grant
+    ages out of observers' pending sets quietly — withdrawal is free.
+    The ``comm.join`` chaos point fires before each announcement write
+    (index = seq).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        token: str,
+        *,
+        generation: int = 0,
+        lease_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        jitter_seed: int = 0,
+        health=None,
+    ):
+        if not str(token):
+            raise ValueError("Joiner: token must be non-empty")
+        if any(sep in str(token) for sep in (os.sep, "/", "\0")):
+            raise ValueError(f"Joiner: token {token!r} is not a filename")
+        self.dir = directory
+        self.token = str(token)
+        self.generation = int(generation)
+        self.lease_s = float(lease_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._rng = random.Random((jitter_seed << 16) ^ (hash(token) & 0xFFFF))
+        self._health = health
+        self._seq = 0
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _join_path(self) -> str:
+        return os.path.join(self.dir, f"{_JOIN_PREFIX}{self.token}.json")
+
+    def _grant_path(self) -> str:
+        return os.path.join(self.dir, f"{_GRANT_PREFIX}{self.token}.json")
+
+    def announce(self) -> int:
+        """Advance and publish the join lease; returns the new seq.  The
+        ``comm.join`` chaos point fires first (index = seq) — a ``raise``
+        clause is a lost announcement, a ``sigterm`` a joiner preempted
+        mid-rendezvous."""
+        self._seq += 1
+        seq = self._seq
+        chaos.fire("comm.join", index=seq)
+        _atomic_write_json(
+            self._join_path(),
+            {
+                "token": self.token,
+                "seq": seq,
+                "pid": os.getpid(),
+                "generation": self.generation,
+                "wall": time.time(),  # diagnostic only, never compared
+            },
+        )
+        return seq
+
+    def grant(self) -> Optional[dict]:
+        """The supervisor's answer, or None while it is still pending.
+        A grant for a different token (impossible under the path scheme)
+        or a torn file reads as pending."""
+        rec = _read_json(self._grant_path())
+        if rec is not None and rec.get("token") == self.token:
+            return rec
+        return None
+
+    def join(
+        self,
+        deadline_s: float,
+        *,
+        backoff_s: float = 0.05,
+        backoff_factor: float = 2.0,
+        backoff_max_s: float = 1.0,
+    ) -> dict:
+        """Announce into the live generation and wait for the grant;
+        returns the grant record (``rank``/``generation``/``world_size``).
+
+        Retrying like :meth:`Membership.rendezvous`: each attempt
+        re-announces (keeping the join lease fresh so observers never age
+        it out mid-wait; an injected :class:`~dgraph_tpu.chaos.
+        ChaosFault` counts as a failed attempt and is retried) and
+        re-reads the grant; between attempts the wait grows
+        ``backoff_s * backoff_factor**k`` capped at ``backoff_max_s``
+        plus a token-seeded jitter.  Past ``deadline_s``:
+        :class:`DeadlineExceeded`.
+        """
+        t0 = self._clock()
+        attempt = 0
+        with spans.span(
+            "membership.join", token=self.token,
+            generation=self.generation,
+        ) as jspan:
+            while True:
+                try:
+                    self.announce()
+                    got = self.grant()
+                    if got is not None:
+                        jspan.annotate(
+                            attempts=attempt + 1, rank=got.get("rank"),
+                            world_size=got.get("world_size"),
+                        )
+                        if self._health is not None:
+                            self._health.record_event({
+                                "kind": "join_granted", **got,
+                            })
+                        return got
+                except chaos.ChaosFault:
+                    pass  # injected transient: retry with backoff
+                delay = min(
+                    backoff_s * backoff_factor ** attempt, backoff_max_s
+                ) + self._rng.uniform(0.0, backoff_s)
+                if self._clock() - t0 + delay >= deadline_s:
+                    err = DeadlineExceeded(
+                        f"join {self.token!r}", deadline_s, missing=(),
+                    )
+                    jspan.end(error=str(err), attempts=attempt + 1)
+                    if self._health is not None:
+                        self._health.record_event(err.record())
+                    raise err
+                self._sleep(delay)
+                attempt += 1
+
+
 def read_roster(directory: str) -> dict:
     """Read-only snapshot of a membership directory: every member's last
     published lease, ACROSS generations (the operator's "who was here"
     probe — a post-shrink dir's members all carry generation > 0, and a
     diagnostic that filtered them out would go blank exactly when the
-    world is degraded).  Never creates or mutates anything; raises
-    FileNotFoundError for a missing directory (a typo'd path must not be
-    silently created as an empty world)."""
+    world is degraded).  Join announcements render too, keyed
+    ``"join:<token>"`` with a ``granted`` flag (and the granted rank when
+    the supervisor answered) — a grow transition's rendezvous must be as
+    legible after the fact as a member's lease.  Never creates or
+    mutates anything; raises FileNotFoundError for a missing directory
+    (a typo'd path must not be silently created as an empty world)."""
     out = {}
     for name in os.listdir(directory):  # propagates FileNotFoundError
         if name.startswith(_MEMBER_PREFIX) and name.endswith(".json"):
@@ -700,6 +988,16 @@ def read_roster(directory: str) -> dict:
                     os.path.join(directory, f"{_LEFT_PREFIX}{rec['rank']}")
                 )
                 out[int(rec["rank"])] = rec
+    for token, rec in _read_join_files(directory, None).items():
+        rec = dict(rec)
+        grant = _read_json(
+            os.path.join(directory, f"{_GRANT_PREFIX}{token}.json")
+        )
+        rec["granted"] = grant is not None
+        if grant is not None:
+            rec["granted_rank"] = grant.get("rank")
+            rec["granted_generation"] = grant.get("generation")
+        out[f"join:{token}"] = rec
     return out
 
 
@@ -885,7 +1183,72 @@ def _selftest() -> dict:  # noqa: C901 — one linear scenario script
               f"health events {kinds}")
         json.dumps(h.finish())
 
+    with tempfile.TemporaryDirectory() as tmp:
+        # --- join rendezvous: announce -> observe -> grant -> joined ---
+        clock5 = _FakeClock()
+        obs = Membership(tmp, rank=0, world_size=2, lease_s=2.0,
+                         clock=clock5, sleep=clock5.sleep)
+        peer = Membership(tmp, rank=1, world_size=2, lease_s=2.0,
+                          clock=clock5, sleep=clock5.sleep)
+        peer.heartbeat()
+        obs.heartbeat(), obs.poll()
+        # an hours-old observer must judge the newcomer from FIRST-
+        # OBSERVED seq, not from its own epoch (the joiner-ageing rule)
+        clock5.sleep(1000.0)
+        obs.heartbeat(), peer.heartbeat()
+        obs.poll()
+        j = Joiner(tmp, "node-b7", generation=0, lease_s=2.0,
+                   clock=clock5, sleep=clock5.sleep)
+        j.announce()
+        evs = obs.poll()
+        reqs = [e for e in evs if e.kind == "join_request"]
+        check([e.token for e in reqs] == ["node-b7"],
+              f"join_request events {evs}")
+        check(obs.pending_joins() == ("node-b7",),
+              f"pending joins {obs.pending_joins()}")
+        check(not [e for e in obs.poll() if e.kind == "join_request"],
+              "join_request re-reported for an already-seen token")
+        check(obs.pending_joins() == ("node-b7",),
+              "fresh join aged out before its lease (first-observed-seq "
+              "rule violated)")
+        # the grant completes the joiner's side of the rendezvous
+        grant_join(tmp, "node-b7", rank=2, generation=1, world_size=3)
+        got = j.join(deadline_s=5.0)
+        check(got["rank"] == 2 and got["world_size"] == 3,
+              f"grant record {got}")
+        json.dumps(got)
+        # silence past the lease (after first observation) expires the
+        # announcement quietly — withdrawal is a non-event, never a loss.
+        # Two silent windows: the first poll still refreshes on the seq
+        # the join() call itself advanced.
+        clock5.sleep(2.5)
+        obs.heartbeat(), peer.heartbeat()
+        obs.poll()
+        clock5.sleep(2.5)
+        obs.heartbeat(), peer.heartbeat()
+        evs = obs.poll()
+        check(obs.pending_joins() == (),
+              f"withdrawn join still pending {obs.pending_joins()}")
+        check(not [e for e in evs if e.kind == "rank_lost"],
+              "an expired join announcement was reported as rank loss")
+        # roster renders the join with its grant
+        roster = read_roster(tmp)
+        check(roster["join:node-b7"]["granted"]
+              and roster["join:node-b7"]["granted_rank"] == 2,
+              f"roster join entry {roster.get('join:node-b7')}")
+        check(sorted(k for k in roster if isinstance(k, int)) == [0, 1],
+              f"roster member ranks {sorted(roster, key=str)}")
+        # a join deadline names itself
+        lonely = Joiner(tmp, "never-granted", generation=0, lease_s=2.0,
+                        clock=clock5, sleep=clock5.sleep)
+        try:
+            lonely.join(deadline_s=1.0)
+            failures.append("ungranted join did not time out")
+        except DeadlineExceeded as e:
+            json.dumps(e.record())
+
     check(RANK_LOST_EXIT_CODE == 19, "RANK_LOST_EXIT_CODE drifted")
+    check(RANK_JOIN_EXIT_CODE == 23, "RANK_JOIN_EXIT_CODE drifted")
     return {"kind": "membership_selftest", "failures": failures}
 
 

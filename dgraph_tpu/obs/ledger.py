@@ -90,6 +90,7 @@ ENTRY_KINDS = (
     "serve_health",      # serving latency/recompile/tenant record
     "supervise_lineage",        # single-child restart lineage
     "supervise_group_lineage",  # multi-rank group lineage
+    "grow_transition",   # adopted W -> W+k elastic expansion (train.grow)
     "run_health",        # standalone CLI startup/exit health record
     "reference_note",    # BASELINE.json-style reference metadata
 )
@@ -545,6 +546,34 @@ def _norm_wire_compile(obj: dict, source: str) -> tuple:
     )], []
 
 
+def _norm_grow_transition(obj: dict, source: str) -> tuple:
+    """grow_transition: one adopted W -> W+k elastic expansion
+    (``train.grow.grow_record``). The world/shard counts carry the
+    exact-class ``_count`` suffixes, so a transition that resharded to
+    the wrong world size — or wrote a different shard count for the same
+    generation — goes RED with zero tolerance, while the re-plan wall
+    time rides the noise-aware timing gate. The joined tokens and the
+    resume step are provenance (meta), not gated numbers."""
+    replan_s = obj.get("replan_s")
+    metrics = {
+        "old_world_count": obj.get("old_world"),
+        "new_world_count": obj.get("new_world"),
+        "shards_count": obj.get("shards"),
+        "replan_ms": (replan_s * 1000.0
+                      if isinstance(replan_s, (int, float))
+                      and not isinstance(replan_s, bool) else None),
+    }
+    return [_entry(
+        "grow_transition", metrics,
+        workload=f"grow_g{obj.get('generation')}",
+        git_rev=obj.get("git_rev"), recorded_at=obj.get("recorded_at"),
+        source=source,
+        meta={"generation": obj.get("generation"),
+              "resume_step": obj.get("resume_step"),
+              "joined": obj.get("joined")},
+    )], []
+
+
 def _norm_run_health(obj: dict, source: str) -> tuple:
     metrics = {"wall_s": obj.get("wall_s"),
                "n_probes": len(obj.get("probes") or [])}
@@ -602,6 +631,8 @@ def normalize_record(obj, source: str = "") -> tuple:
             return _norm_serve_health(obj, source)
         if kind in ("supervise_lineage", "supervise_group_lineage"):
             return _norm_lineage(obj, source)
+        if kind == "grow_transition":
+            return _norm_grow_transition(obj, source)
         if kind == "run_health":
             return _norm_run_health(obj, source)
         if kind == "sched_compile":
@@ -809,6 +840,25 @@ def _selftest() -> dict:
         entries, _ = read_ledger(tmp)
         check(any(e["kind"] == "probe_wedge" and e["round"] == 5
                   for e in entries), "probe stub did not land as probe_wedge")
+
+        # grow transition -> exact-class world/shard counts + timing
+        grow = {"kind": "grow_transition", "generation": 1, "old_world": 2,
+                "new_world": 3, "resume_step": 3, "joined": ["newcomer-a"],
+                "replan_s": 0.125, "shards": 3, "git_rev": "abc1234",
+                "recorded_at": "2026-08-06T00:00:00Z"}
+        r = ingest(grow, "grow_g1.json", tmp)
+        check(r["appended"] == 1 and not r["skipped"],
+              f"grow_transition ingest: {r}")
+        entries, _ = read_ledger(tmp)
+        ge = next((e for e in entries if e["kind"] == "grow_transition"),
+                  None)
+        check(ge is not None
+              and ge["metrics"].get("new_world_count") == 3
+              and ge["metrics"].get("old_world_count") == 2
+              and ge["metrics"].get("shards_count") == 3
+              and ge["metrics"].get("replan_ms") == 125.0
+              and ge["meta"].get("joined") == ["newcomer-a"],
+              f"grow_transition entry malformed: {ge}")
 
         # idempotence: same artifact again -> all deduped
         r = ingest(_fixture_bench_round(), "BENCH_r06.json", tmp)

@@ -28,12 +28,12 @@ format — ``utils.logging.ExperimentLog`` itself imports jax, which this
 module may not: it is jax-free by the same lint-enforced contract as the
 ledger, and runs on a machine where jax is wedged or absent).
 
-``--selftest`` seeds a synthetic trajectory and six drifted mutants
+``--selftest`` seeds a synthetic trajectory and seven drifted mutants
 (inflated wire bytes, slowed scan-delta, fattened p99, dropped tier,
-drifted compiled schedule, drifted wire-format bytes) — each must go
-RED, and the clean trajectory must stay GREEN, or the selftest itself
-fails (the vacuity guard: a sentinel that can't see seeded drift gates
-nothing).
+drifted compiled schedule, drifted wire-format bytes, drifted grown
+world) — each must go RED, and the clean trajectory must stay GREEN, or
+the selftest itself fails (the vacuity guard: a sentinel that can't see
+seeded drift gates nothing).
 """
 
 from __future__ import annotations
@@ -335,12 +335,29 @@ def _fx_wire(i: int, *, operand_bytes: int = 1024) -> dict:
     }
 
 
+def _fx_grow(i: int, *, new_world: int = 3) -> dict:
+    """One adopted grow transition (train.grow -> obs.ledger
+    ``grow_transition``). The world/shard counts carry the exact-class
+    ``_count`` suffixes, so the mutant's drifted world size must go RED
+    with zero tolerance; ``replan_ms`` rides the timing gate."""
+    jitter = [0.0, 0.4, -0.2, 0.1, 0.3, -0.1, 0.2][i % 7]
+    return {
+        "kind": "grow_transition",
+        "generation": 1, "old_world": 2, "new_world": new_world,
+        "resume_step": 3, "joined": ["newcomer-a"],
+        "replan_s": (120.0 + jitter) / 1000.0, "shards": new_world,
+        "git_rev": f"rev{i:04d}",
+        "recorded_at": f"2026-08-01T04:{i:02d}:00Z",
+    }
+
+
 def _seed(tmp: str, n: int = 6) -> None:
     for i in range(n):
         ingest(_fx_round(i), f"fixture_r{i:02d}", tmp)
         ingest(_fx_serve(i), f"fixture_serve_r{i:02d}", tmp)
         ingest(_fx_sched(i), f"fixture_sched_r{i:02d}", tmp)
         ingest(_fx_wire(i), f"fixture_wire_r{i:02d}", tmp)
+        ingest(_fx_grow(i), f"fixture_grow_r{i:02d}", tmp)
 
 
 def _selftest() -> dict:
@@ -410,6 +427,14 @@ def _selftest() -> dict:
             lambda tmp: ingest(_fx_wire(6, operand_bytes=1024 + 64),
                                "fixture_wire_r06", tmp),
             "operand_bytes",
+        ),
+        # 7. drifted grown world: a re-recorded generation-1 transition
+        # whose adopted world size changed 3 -> 4 — a grow path that
+        # reshards to the wrong world must hit the byte-exact class
+        "drifted_world": (
+            lambda tmp: ingest(_fx_grow(6, new_world=4),
+                               "fixture_grow_r06", tmp),
+            "world_count",
         ),
     }
     for name, (mutate, expect_metric) in mutants.items():

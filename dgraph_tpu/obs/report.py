@@ -198,8 +198,9 @@ def _selftest() -> dict:
         md = render_trajectory(entries, directory=tmp)
         for want in ("## Bench rounds", "## cpu_scan_delta",
                      "## serve_health", "## sched_compile",
-                     "## wire_compile",
-                     "operand_bytes", "exchange_ms", "p99_ms", "450."):
+                     "## wire_compile", "## grow_transition",
+                     "operand_bytes", "exchange_ms", "p99_ms",
+                     "new_world_count", "450."):
             check(want in md, f"rendered trajectory lacks {want!r}")
     return {"kind": "report_selftest", "failures": failures,
             "ok": not failures}

@@ -498,6 +498,86 @@ def fold_partition(
     return new_part, survivor_map
 
 
+def unfold_partition(
+    partition: np.ndarray, world_size: int, k: int
+) -> tuple[np.ndarray, dict]:
+    """Grow-to-fit a partition: deterministically donate tail chunks of
+    the existing ranks' blocks to ``k`` NEW ranks (ids ``world_size ..
+    world_size+k-1``) — the waterfill inverse of :func:`fold_partition`.
+
+    This is the redistribution step of elastic rank-arrival recovery
+    (:mod:`dgraph_tpu.train.grow`): instead of re-partitioning from
+    scratch (which would move *every* vertex and invalidate locality the
+    tuner already priced), existing ranks' kept vertices never move —
+    each over-level rank donates only the TAIL of its block (its
+    highest-id vertices, so the keepers stay a contiguous prefix after
+    :func:`renumber_contiguous`).  The level is a waterfill mirror of
+    the fold's: the lowest integer ``T`` such that capping every
+    existing rank at ``T`` frees enough vertices to fill ``k`` newcomers
+    to at most ``T`` each; newcomer allocations are trimmed from the
+    HIGHEST-id newcomers first (the same stable tie rule the fold trims
+    survivors with), and donated vertices are handed out in vertex
+    order as contiguous chunks per newcomer.  The whole unfold is a
+    pure function of ``(partition, k)``, so a crashed recovery that
+    reruns lands the identical partition — and on a renumbered
+    partition whose donated chunks sit at the high end of vertex order,
+    ``fold_partition(unfold_partition(p, W, k)[0], W+k, [W..W+k-1])``
+    restores ``p`` exactly (pinned by ``tests/test_grow.py``).
+
+    Returns ``(new_partition, donor_map)`` where ``new_partition`` is
+    over the SAME vertex numbering as the input (run
+    :func:`renumber_contiguous` before building a plan) and
+    ``donor_map`` maps donating old rank id -> number of vertices it
+    donated.
+    """
+    part = np.asarray(partition)
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"unfold_partition: k must be >= 1, got {k}")
+    counts = np.bincount(part, minlength=world_size).astype(np.int64)
+    if len(counts) > world_size:
+        raise ValueError(
+            f"unfold_partition: partition names rank "
+            f"{len(counts) - 1} >= world_size {world_size}"
+        )
+    # waterfill level: lowest integer T with
+    # sum(min(counts, T)) + k*T >= total, i.e. capping every existing
+    # rank at T frees enough orphans to fill k newcomers to <= T each —
+    # the smallest achievable final max-load, deterministic
+    lo, hi = 0, int(counts.max(initial=0))
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if int(np.clip(counts - mid, 0, None).sum()) <= k * mid:
+            hi = mid
+        else:
+            lo = mid + 1
+    level = lo
+    donate = np.clip(counts - level, 0, None).astype(np.int64)
+    donated_total = int(donate.sum())
+    alloc = np.full(k, level, dtype=np.int64)
+    surplus = k * level - donated_total
+    for i in range(k - 1, -1, -1):
+        if surplus <= 0:
+            break
+        take = min(surplus, int(alloc[i]))
+        alloc[i] -= take
+        surplus -= take
+    new_part = part.astype(np.int32).copy()
+    donated_ids = [
+        # the donor's TAIL: its highest-id vertices, so the kept block
+        # stays a contiguous prefix under the existing numbering
+        np.flatnonzero(part == r)[-int(donate[r]):]
+        for r in np.flatnonzero(donate)
+    ]
+    if donated_ids:
+        donated_sorted = np.sort(np.concatenate(donated_ids))
+        new_part[donated_sorted] = world_size + np.repeat(
+            np.arange(k, dtype=np.int32), alloc
+        )
+    donor_map = {int(r): int(donate[r]) for r in np.flatnonzero(donate)}
+    return new_part, donor_map
+
+
 def edge_cut(edge_index: np.ndarray, partition: np.ndarray) -> float:
     """Fraction of edges crossing partitions (quality metric)."""
     src, dst = edge_index[0], edge_index[1]

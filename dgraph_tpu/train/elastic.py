@@ -224,7 +224,7 @@ def run_elastic(
     restart, same world) before its peers declare it lost.
     """
     from dgraph_tpu import chaos
-    from dgraph_tpu.comm.membership import RankLostError
+    from dgraph_tpu.comm.membership import RankJoinError, RankLostError
     from dgraph_tpu.train.checkpoint import save_checkpoint
     from dgraph_tpu.train.guard import NonFiniteAbort
 
@@ -307,9 +307,9 @@ def run_elastic(
                 mem_next = (
                     time.monotonic() + membership.heartbeat_interval_s
                 )
-                lost_events = [
-                    e for e in membership.poll() if e.kind == "rank_lost"
-                ]
+                evs = membership.poll()
+                lost_events = [e for e in evs if e.kind == "rank_lost"]
+                join_events = [e for e in evs if e.kind == "join_request"]
                 if lost_events:
                     # a survivor's job: land a durable checkpoint (its
                     # block of the next consistent cut) and exit for the
@@ -321,6 +321,23 @@ def run_elastic(
                         tuple(lost_events),
                     )
                     run_span.annotate(rank_lost=[e.rank for e in lost_events])
+                    raise err
+                if join_events:
+                    # the arrival mirror: land a durable checkpoint (this
+                    # rank's block of the cut the grow transition will
+                    # reshard from) and exit for the group supervisor's
+                    # grow path. Loss wins when both land in one poll —
+                    # the world must shrink to a consistent cut before it
+                    # can entertain newcomers.
+                    if ckpt_dir and is_lead:
+                        _save(state, step + 1)
+                    err = RankJoinError(
+                        tuple(e.token for e in join_events),
+                        tuple(join_events),
+                    )
+                    run_span.annotate(
+                        rank_join=[e.token for e in join_events]
+                    )
                     raise err
             done_now = guard.should_stop()
             periodic = (

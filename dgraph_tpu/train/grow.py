@@ -1,0 +1,529 @@
+"""Grow-to-fit elastic world expansion: re-plan, reshard, adopt.
+
+The arrival mirror of :mod:`dgraph_tpu.train.shrink` — rank *arrival*
+treated as a planned redistribution to a LARGER world ("Memory-efficient
+array redistribution through portable collective communication",
+PAPERS.md) instead of a restart-from-scratch.  Detection lives in
+:mod:`dgraph_tpu.comm.membership` (the :class:`~dgraph_tpu.comm.
+membership.Joiner` announcement + :class:`~dgraph_tpu.comm.membership.
+JoinRequest` poll events); the restart policy in :func:`dgraph_tpu.train.
+supervise.supervise_group`'s ``on_rank_join`` path; this module owns the
+world-growth transition itself:
+
+- **Same run directory, same generational artifacts.** A grow transition
+  writes the NEXT generation of the exact layout shrink owns —
+  ``plan_g<g>``, ``ckpt_g<g>/rank_<r>``, ``membership_g<g>_a<a>``,
+  ``graph_g<g>.npz`` — and commits it with the same single atomic
+  ``world.json`` pointer flip.  Grow and shrink transitions compose
+  freely into one generation chain (g0 → grow → g1 → shrink → g2 ...),
+  because every generation is self-describing and every reader derives
+  its paths from the adopted pointer.
+
+- **Grow = unfold + rebuild + reshard + atomic adopt.**
+  :func:`grow_world` donates tail chunks of the existing ranks' blocks
+  to the newcomers (:func:`~dgraph_tpu.partition.unfold_partition` —
+  the deterministic waterfill inverse of ``fold_partition``; kept
+  vertices never move), renumbers, and rebuilds the plan for W+k **in
+  the background** through the streaming resumable builder
+  (:func:`~dgraph_tpu.train.shrink.build_generation_plan`) while the
+  foreground gathers the newest checkpoint step durable on EVERY old
+  rank (the last consistent cut) and reshards it row-by-vertex-identity
+  into W+k blocks.  Only after the new plan, checkpoints, and graph
+  snapshot are all durable does ``world.json`` flip — a crash at ANY
+  point leaves either the old world or the new world adopted, never a
+  torn mix (``grow.replan`` / ``grow.adopt`` chaos points make both
+  crash windows injectable).
+
+- **Joiners are granted, never guessed.** New ranks ``W .. W+k-1`` are
+  assigned to join tokens in sorted-token order (deterministic on
+  rerun).  The grant files that tell each joiner its rank are written by
+  the CALLER via :func:`grant_joined` AFTER :func:`grow_world` returns:
+  the pointer flip must be the transition's last filesystem effect
+  (host-pointer-flip-last), and a grant naming generation g+1 must never
+  exist before the pointer that defines it.
+
+- **Bit-identical expanded resume.** Every step is a pure function of
+  ``(old artifacts, join tokens)``; a resumed grown run is bit-identical
+  to a fault-free W+k run started from the same resharded checkpoint —
+  the shrink contract run in reverse, pinned end-to-end by
+  ``tests/test_grow.py``.
+
+This module is lint-enforced jax-free (the grow decision path must keep
+working while jax is wedged); everything that pulls jax — the plan
+builder, the reshard kernel — is reached through :mod:`dgraph_tpu.train.
+shrink`'s function-scope imports.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+import dgraph_tpu.obs.spans as spans
+from dgraph_tpu import chaos
+
+# submodule form, not `from dgraph_tpu.train import shrink`: naming the
+# package would flag the jax-free lint (its __init__ pulls jax); the
+# shrink module itself is the jax quarantine this module rides
+import dgraph_tpu.train.shrink as shrink
+
+_logger = logging.getLogger("dgraph_tpu.grow")
+
+
+class GrowError(RuntimeError):
+    """A world-growth transition could not complete (no pending joiners,
+    no consistent checkpoint cut, missing generation artifacts, ...)."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"grow-to-fit transition failed: {reason}")
+        self.reason = reason
+
+    def record(self) -> dict:
+        return {"kind": "grow_error", "reason": self.reason}
+
+
+def grow_record(rec: dict, replan_s: float = 0.0, shards: int = 0) -> dict:
+    """The ``grow_transition`` ledger record for one adopted transition
+    (:mod:`dgraph_tpu.obs.ledger` ingests it; ``obs.regress`` gates the
+    world/shard counts byte-exact)."""
+    last = (rec.get("join_history") or [{}])[-1]
+    return {
+        "kind": "grow_transition",
+        "generation": int(rec.get("generation", 0)),
+        "old_world": int(rec.get("world_size", 0)) - len(last.get("joined", {})),
+        "new_world": int(rec.get("world_size", 0)),
+        "resume_step": int(rec.get("resume_step", 0)),
+        "joined": sorted(last.get("joined", {})),
+        "replan_s": float(replan_s),
+        "shards": int(shards),
+    }
+
+
+def grow_world(
+    run_dir: str, tokens=None, *, attempt: int = 0,
+) -> dict:
+    """Transition the run to ``W + len(tokens)`` ranks; returns the
+    adopted world record (plus ``resume_step`` and the token -> rank
+    assignment in its ``join_history`` tail).
+
+    ``tokens`` names the joiners; None discovers them from the live
+    generation's membership directory (every pending
+    :class:`~dgraph_tpu.comm.membership.Joiner` announcement for the
+    current generation/``attempt``).  Crash-safe and rerunnable exactly
+    like :func:`~dgraph_tpu.train.shrink.shrink_world`: artifacts are
+    written under the NEW generation's names (the old world stays intact
+    and adopted until the final pointer flip), the plan build resumes
+    from its own manifest, and checkpoint/graph writes are atomic.  The
+    plan rebuild runs in a background thread, overlapped with the
+    checkpoint gather/reshard.
+    """
+    from dgraph_tpu import plan_shards as ps
+    from dgraph_tpu.comm.membership import read_joins
+    from dgraph_tpu.partition import renumber_contiguous, unfold_partition
+    from dgraph_tpu.train.checkpoint import (
+        all_steps,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    world = shrink.read_world(run_dir)
+    gen, W = int(world["generation"]), int(world["world_size"])
+    if tokens is None:
+        tokens = read_joins(
+            shrink.membership_dir(run_dir, gen, attempt), generation=gen,
+        )
+    tokens = sorted(str(t) for t in tokens)
+    if not tokens:
+        raise GrowError(
+            f"no pending join announcements for generation {gen} "
+            f"(membership dir {shrink.membership_dir(run_dir, gen, attempt)})"
+        )
+    k = len(tokens)
+    new_gen, new_world = gen + 1, W + k
+    # deterministic on rerun: new ranks W..W+k-1 in sorted-token order
+    joined = {t: W + i for i, t in enumerate(tokens)}
+    with spans.span(
+        "grow.recover", run_dir=run_dir, generation=new_gen,
+        old_world=W, new_world=new_world, joined=tokens,
+    ) as gspan:
+        # a kill HERE (grow.replan=sigterm@0) leaves zero new-generation
+        # artifacts: the old world stays adopted and untouched
+        chaos.fire("grow.replan")
+        graph = np.load(shrink.graph_path(run_dir, gen))
+        part_unfold, donor_map = unfold_partition(graph["partition"], W, k)
+        ren = renumber_contiguous(part_unfold, new_world)
+        new_edges = ren.perm[np.asarray(graph["edge_index"])]
+        orig_ids = np.asarray(graph["orig_ids"])[ren.inv]
+
+        # background: rebuild the plan for the grown world through the
+        # streaming per-rank builder (durable + resumable, plan.* chaos
+        # points live) while the foreground reshards the checkpoint
+        build_out: dict = {}
+
+        def _build():
+            t0 = time.monotonic()
+            with spans.span("grow.replan", parent=gspan,
+                            world_size=new_world):
+                try:
+                    build_out["manifest"] = shrink.build_generation_plan(
+                        run_dir, new_gen, new_edges, ren.partition,
+                        world, new_world,
+                    )
+                except BaseException as e:  # re-raised on join
+                    build_out["error"] = e
+            build_out["wall_s"] = time.monotonic() - t0
+
+        builder = threading.Thread(target=_build, name="grow-replan")
+        builder.start()
+
+        # foreground: the newest checkpoint step durable on EVERY old
+        # rank — the newcomers start from the old world's last consistent
+        # cut, and a step some rank never finished saving is not one
+        step_sets = [
+            set(all_steps(shrink.rank_ckpt_dir(run_dir, gen, r)))
+            for r in range(W)
+        ]
+        common = set.intersection(*step_sets) if step_sets else set()
+        if not common:
+            builder.join()
+            raise GrowError(
+                f"no checkpoint step durable on all {W} rank(s) of "
+                f"generation {gen} (per-rank steps: "
+                f"{[sorted(s) for s in step_sets]})"
+            )
+        resume_step = max(common)
+        with spans.span("grow.gather", parent=gspan, step=resume_step):
+            per_rank = [
+                restore_checkpoint(
+                    shrink.rank_ckpt_dir(run_dir, gen, r), step=resume_step
+                )
+                for r in range(W)
+            ]
+        builder.join()
+        if "error" in build_out:
+            raise build_out["error"]
+        manifest = build_out["manifest"]
+        statics = manifest["statics"]
+        if not statics.get("homogeneous", True):
+            raise NotImplementedError(
+                "grow_world currently reshards homogeneous vertex state"
+            )
+        n_pad_new = int(statics["n_dst_pad"])
+        old_statics = ps.read_manifest(shrink.plan_dir(run_dir, gen))["statics"]
+        n_pad_old = int(old_statics["n_dst_pad"])
+
+        with spans.span("grow.reshard", parent=gspan, step=resume_step):
+            new_states = shrink._reshard_states(
+                [p["state"] for p in per_rank],
+                np.asarray(graph["counts"]),
+                n_pad_old,
+                ren.inv,
+                ren.counts,
+                n_pad_new,
+                new_world,
+            )
+            for r in range(new_world):
+                save_checkpoint(
+                    shrink.rank_ckpt_dir(run_dir, new_gen, r),
+                    {"state": new_states[r], "step": resume_step},
+                    resume_step,
+                )
+        # atomic like the checkpoints above it: a torn snapshot under a
+        # valid name would poison every later fold/unfold
+        ps.atomic_savez(
+            shrink.graph_path(run_dir, new_gen),
+            edge_index=new_edges,
+            partition=ren.partition,
+            counts=ren.counts,
+            orig_ids=orig_ids,
+        )
+        rec = {
+            **world,
+            "generation": new_gen,
+            "world_size": new_world,
+            "resume_step": int(resume_step),
+            "join_history": list(world.get("join_history", []))
+            + [{"generation": gen, "joined": joined,
+                "donors": donor_map, "resume_step": int(resume_step)}],
+        }
+        # a kill HERE (grow.adopt=sigterm@0) is the torn-window
+        # injection: every new-generation artifact is durable but the
+        # pointer has not flipped — the old world must still read back
+        # cleanly adoptable, and a rerun must resume and commit
+        chaos.fire("grow.adopt")
+        # THE adoption: one atomic rename flips every reader (workers
+        # derive plan/ckpt/membership paths from the generation) to the
+        # grown world
+        shrink.write_world(run_dir, rec)
+        # observability AFTER the commit point: the ledger append is
+        # best-effort (maybe_ingest swallows every failure) and records
+        # only transitions that were actually adopted
+        from dgraph_tpu.obs.ledger import maybe_ingest
+
+        maybe_ingest(
+            grow_record(rec, replan_s=build_out.get("wall_s", 0.0),
+                        shards=new_world),
+            source="train.grow", default_on=False,
+        )
+        gspan.annotate(resume_step=int(resume_step))
+        _logger.info(
+            "grow-to-fit adopted: generation %d, world %d -> %d, joined "
+            "%s, resume step %d", new_gen, W, new_world, tokens,
+            resume_step,
+        )
+    return rec
+
+
+def grant_joined(run_dir: str, rec: dict, *, attempt: int = 0) -> dict:
+    """Answer the joiners a :func:`grow_world` transition adopted: write
+    each token's grant (rank / generation / world size) into the OLD
+    generation's membership directory — the one the joiners are polling.
+    Called AFTER :func:`grow_world` returns, never inside it: the
+    pointer flip is the transition's last filesystem effect, and a grant
+    names a generation that must already be adopted.  Returns the
+    token -> grant-record map."""
+    from dgraph_tpu.comm.membership import grant_join
+
+    if not rec.get("join_history"):
+        raise GrowError("world record carries no join_history to grant")
+    last = rec["join_history"][-1]
+    mdir = shrink.membership_dir(run_dir, int(last["generation"]), attempt)
+    return {
+        token: grant_join(
+            mdir, token, rank=int(rank),
+            generation=int(rec["generation"]),
+            world_size=int(rec["world_size"]),
+        )
+        for token, rank in sorted(last["joined"].items())
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI: `python -m dgraph_tpu.train.grow --selftest true`
+# ---------------------------------------------------------------------------
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class Config:
+    """Grow-to-fit transition CLI (``--selftest`` is the compile-free
+    smoke scripts/check.py gates on; the default runs one grow
+    transition over ``--run_dir``'s pending joiners — the operator's
+    manual scale-up trigger)."""
+
+    selftest: bool = False
+    run_dir: str = ""
+    attempt: int = 0
+    indent: int = 0
+
+
+def _seed_world(run_dir: str, n: int = 16, world: int = 2) -> dict:
+    """A tiny generation-0 elastic run with per-rank checkpoints at
+    steps 0 and 3: vertex-sharded rows carry ``orig_id + 1`` so reshard
+    row identity is checkable by eye, plus a replicated scalar."""
+    from dgraph_tpu import plan_shards as ps
+    from dgraph_tpu.train.checkpoint import save_checkpoint
+
+    edges = np.stack([np.arange(n), (np.arange(n) + 1) % n]).astype(np.int64)
+    shrink.init_world(
+        run_dir, edges, n, world, pad_multiple=2, lease_s=2.0,
+    )
+    graph = np.load(shrink.graph_path(run_dir, 0))
+    counts = np.asarray(graph["counts"])
+    orig = np.asarray(graph["orig_ids"])
+    offsets = np.zeros(world + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    n_pad = int(ps.read_manifest(
+        shrink.plan_dir(run_dir, 0))["statics"]["n_dst_pad"])
+    for r in range(world):
+        w = np.zeros((n_pad,), dtype=np.float64)
+        own = orig[offsets[r]:offsets[r] + counts[r]]
+        w[:counts[r]] = own + 1.0
+        state = {"w": w, "lr": 0.5}
+        for s in (0, 3):
+            save_checkpoint(
+                shrink.rank_ckpt_dir(run_dir, 0, r),
+                {"state": state, "step": s}, s,
+            )
+    return {"n_pad": n_pad, "counts": counts, "orig": orig}
+
+
+def _selftest() -> dict:  # noqa: C901 — one linear scenario script
+    import json
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    import dgraph_tpu.comm.membership as ms
+    from dgraph_tpu.train.checkpoint import restore_checkpoint
+
+    failures: list = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # --- fake-clock grow smoke: announce -> observe -> grow -> grant
+        run_dir = os.path.join(tmp, "run")
+        _seed_world(run_dir)
+        clock = ms._FakeClock()
+        mdir = shrink.membership_dir(run_dir, 0, 0)
+        joiner = ms.Joiner(mdir, "newcomer-a", generation=0, lease_s=2.0,
+                           clock=clock, sleep=clock.sleep)
+        joiner.announce()
+        obs = ms.Membership(mdir, rank=0, world_size=2, lease_s=2.0,
+                            clock=clock, sleep=clock.sleep)
+        evs = obs.poll()
+        check(
+            [e.token for e in evs if e.kind == "join_request"]
+            == ["newcomer-a"],
+            f"join not observed: {evs}",
+        )
+        rec = grow_world(run_dir)  # discovery from the membership dir
+        check(rec["generation"] == 1 and rec["world_size"] == 3,
+              f"adopted record {rec}")
+        check(rec["resume_step"] == 3,
+              f"resume step {rec['resume_step']} != newest common cut 3")
+        check(rec["join_history"][-1]["joined"] == {"newcomer-a": 2},
+              f"join history {rec['join_history']}")
+        adopted = shrink.read_world(run_dir)
+        check(adopted["generation"] == 1, "pointer did not flip")
+        # resharded rows preserve vertex identity; replicated adopted
+        g1 = np.load(shrink.graph_path(run_dir, 1))
+        counts1 = np.asarray(g1["counts"])
+        orig1 = np.asarray(g1["orig_ids"])
+        check(int(counts1.sum()) == 16 and len(counts1) == 3,
+              f"grown counts {counts1}")
+        offsets1 = np.zeros(4, dtype=np.int64)
+        np.cumsum(counts1, out=offsets1[1:])
+        for r in range(3):
+            got = restore_checkpoint(
+                shrink.rank_ckpt_dir(run_dir, 1, r), step=3)
+            w = np.asarray(got["state"]["w"])
+            own = orig1[offsets1[r]:offsets1[r] + counts1[r]]
+            check(
+                np.array_equal(w[:counts1[r]], own + 1.0),
+                f"rank {r} resharded rows lost vertex identity",
+            )
+            check(got["state"]["lr"] == 0.5, f"rank {r} replicated leaf")
+        # grants land AFTER adoption, in the OLD generation's dir
+        grants = grant_joined(run_dir, rec, attempt=0)
+        check(grants["newcomer-a"]["rank"] == 2, f"grants {grants}")
+        got = joiner.join(deadline_s=5.0)
+        check(got["rank"] == 2 and got["generation"] == 1
+              and got["world_size"] == 3, f"joiner grant {got}")
+        # a rerun finds no pending joiners in the NEW generation
+        try:
+            grow_world(run_dir)
+            failures.append("grow with no pending joiners did not raise")
+        except GrowError as e:
+            json.dumps(e.record())
+        # the ledger record derives from the adopted pointer
+        lrec = grow_record(rec, replan_s=0.25, shards=3)
+        check(lrec["old_world"] == 2 and lrec["new_world"] == 3
+              and lrec["joined"] == ["newcomer-a"],
+              f"grow_record {lrec}")
+        json.dumps(lrec)
+
+    # --- subprocess sigterm pins: both crash windows leave world.json
+    # pointing at a complete generation (old), and a clean rerun commits
+    child = (
+        "import sys; from dgraph_tpu.train import grow; "
+        "grow.grow_world(sys.argv[1], tokens=['newcomer-a'])"
+    )
+    for name, spec in (
+        ("adopt-boundary", "grow.adopt=sigterm@0"),
+        ("mid-shard-stream", "plan.write=sigterm@1"),
+    ):
+        with tempfile.TemporaryDirectory() as tmp:
+            run_dir = os.path.join(tmp, "run")
+            _seed_world(run_dir)
+            env = dict(os.environ)
+            env["DGRAPH_CHAOS"] = spec
+            env["JAX_PLATFORMS"] = "cpu"
+            proc = subprocess.run(
+                [sys.executable, "-c", child, run_dir],
+                env=env, capture_output=True, text=True, timeout=300,
+            )
+            check(
+                proc.returncode == -signal.SIGTERM,
+                f"{name}: child exit {proc.returncode} "
+                f"(stderr tail: {proc.stderr[-300:]!r})",
+            )
+            world = shrink.read_world(run_dir)
+            check(
+                world["generation"] == 0 and world["world_size"] == 2,
+                f"{name}: interrupted transition left pointer at "
+                f"{world['generation']} (old world must stay adopted)",
+            )
+            # the old generation is still fully usable AND the rerun
+            # resumes the torn transition to completion
+            rec = grow_world(run_dir, tokens=["newcomer-a"])
+            check(
+                rec["generation"] == 1 and rec["world_size"] == 3,
+                f"{name}: rerun did not adopt ({rec})",
+            )
+
+    return {"kind": "grow_selftest", "failures": failures}
+
+
+def main(cfg: Config) -> dict:
+    import json
+
+    from dgraph_tpu.obs.health import RunHealth
+
+    health = RunHealth.begin("grow.cli")
+    if cfg.selftest:
+        try:
+            out = _selftest()
+        except BaseException as e:  # every exit path carries RunHealth
+            rec = {
+                "kind": "grow_selftest",
+                "failures": [f"crashed: {type(e).__name__}: {e}"],
+                "run_health": health.finish(
+                    f"grow selftest crashed: {type(e).__name__}: {e}",
+                    wedge="stage_failure",
+                ),
+            }
+            print(json.dumps(rec, indent=cfg.indent or None))
+            raise
+        failures = out["failures"]
+        out["run_health"] = health.finish(
+            "; ".join(failures) if failures else None,
+            wedge="stage_failure" if failures else None,
+        )
+        print(json.dumps(out, indent=cfg.indent or None))
+        if failures:
+            raise SystemExit(
+                "grow selftest FAILED: " + "; ".join(failures)
+            )
+        return out
+    if not cfg.run_dir:
+        raise SystemExit(
+            "nothing to do: pass --selftest true, or --run_dir <elastic "
+            "run dir> to grow it over its pending joiners"
+        )
+    rec = grow_world(cfg.run_dir, attempt=cfg.attempt)
+    grants = grant_joined(cfg.run_dir, rec, attempt=cfg.attempt)
+    out = {
+        "kind": "grow_transition_cli",
+        "world": rec,
+        "grants": grants,
+        "run_health": health.finish(),
+    }
+    print(json.dumps(out, indent=cfg.indent or None, default=str))
+    return out
+
+
+if __name__ == "__main__":
+    from dgraph_tpu.utils.cli import parse_config
+
+    main(parse_config(Config))

@@ -199,6 +199,34 @@ def init_world(
     return rec
 
 
+def build_generation_plan(
+    run_dir: str,
+    generation: int,
+    edges: np.ndarray,
+    partition: np.ndarray,
+    world: dict,
+    world_size: int,
+) -> dict:
+    """Rebuild the sharded plan artifact for one generation through the
+    streaming per-rank builder (durable after every shard, RESUMABLE from
+    its own manifest), replaying the world record's plan knobs — a
+    transition that rebuilt without the interior/boundary split would
+    silently outlaw the overlap/pallas_p2p lowerings in the new world.
+    Shared by the shrink AND grow transitions (:mod:`dgraph_tpu.train.
+    grow` is lint-enforced jax-free, so the jax-pulling
+    :mod:`dgraph_tpu.plan` import stays quarantined here)."""
+    from dgraph_tpu.plan import build_plan_shards
+
+    return build_plan_shards(
+        edges, partition,
+        out_dir=plan_dir(run_dir, generation),
+        world_size=world_size,
+        pad_multiple=int(world.get("pad_multiple", 8)),
+        overlap=world.get("plan_overlap", False) or None,
+        write_layout=False,
+    )
+
+
 def _walk_leaves(tree, path=()):
     """(path, leaf) pairs over dict/list/tuple trees — hand-rolled like
     chaos.poison_pytree; checkpointed host state is plain containers."""
@@ -288,7 +316,6 @@ def shrink_world(run_dir: str, lost_ranks) -> dict:
     """
     from dgraph_tpu import plan_shards as ps
     from dgraph_tpu.partition import fold_partition, renumber_contiguous
-    from dgraph_tpu.plan import build_plan_shards
     from dgraph_tpu.train.checkpoint import (
         all_steps,
         restore_checkpoint,
@@ -324,13 +351,9 @@ def shrink_world(run_dir: str, lost_ranks) -> dict:
             with spans.span("shrink.replan", parent=rspan,
                             world_size=new_world):
                 try:
-                    build_out["manifest"] = build_plan_shards(
-                        new_edges, ren.partition,
-                        out_dir=plan_dir(run_dir, new_gen),
-                        world_size=new_world,
-                        pad_multiple=int(world.get("pad_multiple", 8)),
-                        overlap=world.get("plan_overlap", False) or None,
-                        write_layout=False,
+                    build_out["manifest"] = build_generation_plan(
+                        run_dir, new_gen, new_edges, ren.partition,
+                        world, new_world,
                     )
                 except BaseException as e:  # re-raised on join
                     build_out["error"] = e

@@ -53,10 +53,14 @@ if __name__ == "_dgraph_train_supervise":  # standalone (bench supervisor)
     ATTEMPT_ENV_VAR = "DGRAPH_CHAOS_ATTEMPT"  # chaos.ATTEMPT_ENV_VAR
     RANK_ENV_VAR = "DGRAPH_RANK"  # utils.env.RANK_ENV_VAR
     RANK_LOST_EXIT_CODE = 19  # comm.membership.RANK_LOST_EXIT_CODE
+    RANK_JOIN_EXIT_CODE = 23  # comm.membership.RANK_JOIN_EXIT_CODE
 else:
     import dgraph_tpu.obs.spans as spans  # jax-free (lint-enforced)
     from dgraph_tpu.chaos import ATTEMPT_ENV_VAR
-    from dgraph_tpu.comm.membership import RANK_LOST_EXIT_CODE
+    from dgraph_tpu.comm.membership import (
+        RANK_JOIN_EXIT_CODE,
+        RANK_LOST_EXIT_CODE,
+    )
     from dgraph_tpu.utils.env import RANK_ENV_VAR
     from dgraph_tpu.train.elastic import WEDGED_EXIT_CODE
 
@@ -106,7 +110,8 @@ def _backoff_delay(attempt: int, backoff_s: float, backoff_factor: float,
 
 def _final_error(rc, last_outcome: str, restarts: int, *, max_restarts: int,
                  budget_s: float, budget_exhausted: bool, gave_up: bool,
-                 stopped_on_loss: bool = False, what: str = "child"):
+                 stopped_on_loss: bool = False, stopped_on_join: bool = False,
+                 what: str = "child"):
     """(error, wedge) summary shared by both supervisors' lineages."""
     if rc == 0:
         return None, None
@@ -114,6 +119,8 @@ def _final_error(rc, last_outcome: str, restarts: int, *, max_restarts: int,
         exhausted = f"; wall budget ({budget_s:g}s) exhausted"
     elif stopped_on_loss:
         exhausted = "; stopped on rank loss (no shrink path)"
+    elif stopped_on_join:
+        exhausted = "; stopped on rank join (no grow path)"
     elif gave_up:
         exhausted = f"; restart budget ({max_restarts}) exhausted"
     else:
@@ -387,6 +394,7 @@ def supervise_group(
     rank_loss_grace_s: float = 30.0,
     min_world: int = 1,
     on_rank_loss=None,
+    on_rank_join=None,
     resume_step_fn=None,
     ckpt_dir: str = "",
     env: Optional[dict] = None,
@@ -428,6 +436,18 @@ def supervise_group(
       the same world size while ``restart_on_crash`` holds.
     - ``on_rank_loss=None`` (or a shrink below ``min_world``) stops the
       group with the rank-loss exit code instead of shrinking.
+    - ranks exit :data:`RANK_JOIN_EXIT_CODE` (23) after observing a
+      join announcement (:class:`~dgraph_tpu.comm.membership.Joiner`):
+      the symmetric GROW path.  The same grace window lets the rest of
+      the group observe, checkpoint, and exit 23; once every live rank
+      reported, ``on_rank_join(world_size, attempt)`` runs the grow-to-
+      fit transition (re-plan + checkpoint reshard + grant —
+      :func:`dgraph_tpu.train.grow.grow_world`) and returns the new
+      world size; the group relaunches at ``W + k`` with ranks
+      renumbered.  ``on_rank_join=None`` stops the group with the
+      rank-join exit code instead of growing.  Loss outranks arrival
+      when both land in one attempt — the world must shrink to a
+      consistent cut before it can entertain newcomers.
 
     ``budget_s`` is the SHARED fail-fast wall budget across every rank and
     attempt (the single-mode contract); per-attempt timeouts are clamped
@@ -450,10 +470,12 @@ def supervise_group(
     W = int(world_size)
     attempts: list = []
     shrinks: list = []
+    grows: list = []
     rc: Optional[int] = None
     gave_up = False
     budget_exhausted = False
     stopped_on_loss = False
+    stopped_on_join = False
     t_start = _clock()
     for attempt in range(max_restarts + 1):
         delay = _backoff_delay(attempt, backoff_s, backoff_factor,
@@ -553,15 +575,28 @@ def supervise_group(
                     crashed_now = [
                         r for r, c in exit_codes.items()
                         if c not in (0, WEDGED_EXIT_CODE,
-                                     RANK_LOST_EXIT_CODE)
+                                     RANK_LOST_EXIT_CODE,
+                                     RANK_JOIN_EXIT_CODE)
                     ]
                     reporters = [
                         r for r, c in exit_codes.items()
                         if c == RANK_LOST_EXIT_CODE
                     ]
+                    # 23-reporters (observed a join announcement) share
+                    # the loss quorum rule: the first reporter starts the
+                    # grace window only as a quorum of what's left, so
+                    # the rest of the group gets time to observe the same
+                    # join, checkpoint, and exit 23 — without one early
+                    # observer aborting a healthy still-training group
+                    join_reporters = [
+                        r for r, c in exit_codes.items()
+                        if c == RANK_JOIN_EXIT_CODE
+                    ]
                     if grace_deadline is None and (
                         crashed_now
                         or (reporters and len(reporters) >= len(live))
+                        or (join_reporters
+                            and len(join_reporters) >= len(live))
                     ):
                         grace_deadline = now + rank_loss_grace_s
                     if grace_deadline is None or now < grace_deadline:
@@ -591,6 +626,8 @@ def supervise_group(
                 outcome = "wedged"
             elif code == RANK_LOST_EXIT_CODE:
                 outcome = "rank_lost"
+            elif code == RANK_JOIN_EXIT_CODE:
+                outcome = "rank_join"
             else:
                 outcome = "crashed"
             rank_spans[r].end(
@@ -636,6 +673,13 @@ def supervise_group(
                 rec["exit_code"] for rec in rank_recs
                 if rec["exit_code"] not in (0, None)
             )
+        elif "rank_join" in outcomes and "rank_lost" not in outcomes:
+            # every live rank observed the join announcement and exited
+            # 23 cleanly: the grow path. A simultaneous loss report
+            # falls through to the crashed ladder below instead — the
+            # world must shrink to a consistent cut before it grows
+            group_outcome = "rank_join"
+            rc = RANK_JOIN_EXIT_CODE
         else:  # only ok + rank_lost reporters, nobody actually died
             group_outcome = "crashed"
             rc = RANK_LOST_EXIT_CODE
@@ -648,6 +692,7 @@ def supervise_group(
             "resume_step": resume_step,
             "ranks": rank_recs,
             "shrink": None,
+            "grow": None,
             "span_id": attempt_span.span_id,
         }
         attempt_span.end(
@@ -697,6 +742,33 @@ def supervise_group(
             health.record_event({"kind": "shrink", **shrink_rec})
             W = new_world
             continue
+        if group_outcome == "rank_join":
+            if attempt == max_restarts:
+                # no restart budget left to LAUNCH a grown world: don't
+                # burn the re-plan/reshard on a result nobody would run
+                gave_up = True
+                break
+            if on_rank_join is None:
+                stopped_on_join = True
+                break
+            grow_rec = {"attempt": attempt, "old_world": W}
+            with spans.span(
+                "supervise.grow", parent=run_span, **grow_rec
+            ):
+                got = on_rank_join(W, attempt)
+            if got is None or int(got) <= W:
+                # the callback declined (stale announcement, quota, ...):
+                # nothing grew, so a relaunch at the same world would
+                # just re-observe the join and loop — stop instead
+                stopped_on_join = True
+                break
+            new_world = int(got)
+            grow_rec["new_world"] = new_world
+            attempt_rec["grow"] = grow_rec
+            grows.append(grow_rec)
+            health.record_event({"kind": "grow", **grow_rec})
+            W = new_world
+            continue
         if group_outcome == "crashed" and not restart_on_crash:
             break
         if attempt == max_restarts:
@@ -706,7 +778,8 @@ def supervise_group(
         rc, attempts[-1]["outcome"] if attempts else "never_ran", restarts,
         max_restarts=max_restarts, budget_s=budget_s,
         budget_exhausted=budget_exhausted, gave_up=gave_up,
-        stopped_on_loss=stopped_on_loss, what="group",
+        stopped_on_loss=stopped_on_loss, stopped_on_join=stopped_on_join,
+        what="group",
     )
     run_span.end(
         error=error, restarts=restarts, final_exit_code=rc,
@@ -720,10 +793,12 @@ def supervise_group(
         "attempts": attempts,
         "restarts": restarts,
         "shrinks": shrinks,
+        "grows": grows,
         "final_exit_code": rc,
         "gave_up": gave_up,
         "budget_exhausted": budget_exhausted,
         "stopped_on_rank_loss": stopped_on_loss,
+        "stopped_on_rank_join": stopped_on_join,
         "final_step": _latest_step(ckpt_dir),
         "run_health": health.finish(error, wedge),
     }

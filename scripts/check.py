@@ -76,13 +76,21 @@ PASSES = [
     # transfer must each go RED) — pure stdlib, zero XLA compiles
     ("sched-selftest",
      [sys.executable, "-m", "dgraph_tpu.sched", "--selftest", "true"]),
-    # perf-trajectory drift sentinel: the six seeded-drift vacuity
+    # perf-trajectory drift sentinel: the seven seeded-drift vacuity
     # mutants (inflated wire bytes, slowed scan-delta, fattened p99,
     # dropped fallback tier, drifted schedule, drifted wire-format
-    # bytes) must each go RED and the clean fixture ledger must gate
-    # GREEN — pure stdlib, zero compiles
+    # bytes, drifted grown world) must each go RED and the clean fixture
+    # ledger must gate GREEN — pure stdlib, zero compiles
     ("regress-selftest",
      [sys.executable, "-m", "dgraph_tpu.obs.regress",
+      "--selftest", "true"]),
+    # grow-to-fit transition smoke: join rendezvous -> background W+k
+    # re-plan -> reshard -> atomic adoption on a tiny fixture run, plus
+    # the two subprocess sigterm pins (commit boundary AND mid-shard
+    # stream must both leave world.json on a complete generation) —
+    # compile-free, fake-clock driven
+    ("grow-selftest",
+     [sys.executable, "-m", "dgraph_tpu.train.grow",
       "--selftest", "true"]),
     # wire codec layer: registry byte pins, numpy round-trip bounds per
     # format, the wrong-scale/dropped-row vacuity mutants, the resolver

@@ -32,7 +32,8 @@ SECTIONS = [
     ("Elastic world membership", "dgraph_tpu.comm.membership",
      ["Membership", "RankLost", "MembershipChanged", "Straggler",
       "RankLostError", "DeadlineExceeded", "read_roster",
-      "RANK_LOST_EXIT_CODE"]),
+      "RANK_LOST_EXIT_CODE", "Joiner", "JoinRequest", "RankJoinError",
+      "grant_join", "read_joins", "RANK_JOIN_EXIT_CODE"]),
     ("Communication plans", "dgraph_tpu.plan",
      ["CommPattern", "EdgePlan", "OverlapSpec", "build_edge_plan",
       "build_comm_pattern", "compute_comm_map", "validate_plan",
@@ -81,6 +82,8 @@ SECTIONS = [
     ("Shrink-to-fit recovery", "dgraph_tpu.train.shrink",
      ["init_world", "shrink_world", "read_world", "write_world",
       "ShrinkError"]),
+    ("Grow-to-fit expansion", "dgraph_tpu.train.grow",
+     ["grow_world", "grant_joined", "grow_record", "GrowError"]),
     ("Non-finite step guard", "dgraph_tpu.train.guard",
      ["NonFiniteMonitor", "NonFiniteAbort"]),
     ("Chaos fault injection", "dgraph_tpu.chaos",

@@ -9,7 +9,9 @@ PR 8 rank-subset path), joins the membership directory via a retrying
 rendezvous, and drives a deliberately tiny host-side numpy "training"
 loop through ``run_elastic(membership=...)`` — heartbeating every step,
 checkpointing every step, and exiting ``RANK_LOST_EXIT_CODE`` (19) after
-a durable checkpoint when a peer's lease expires.  The per-vertex update
+a durable checkpoint when a peer's lease expires — or
+``RANK_JOIN_EXIT_CODE`` (23) when a newcomer announces a join, so the
+supervisor can grow the world (test_grow.py).  The per-vertex update
 is keyed by ORIGINAL vertex id (``graph_g<g>.npz``'s ``orig_ids``), so a
 wrong row anywhere in the shrink/reshard pipeline diverges from the
 global oracle the test computes.
@@ -57,8 +59,10 @@ def main() -> None:
         sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
     )
     from dgraph_tpu.comm.membership import (
+        RANK_JOIN_EXIT_CODE,
         RANK_LOST_EXIT_CODE,
         Membership,
+        RankJoinError,
         RankLostError,
         rank_from_env,
     )
@@ -125,6 +129,12 @@ def main() -> None:
         print(f"WORKER_RANK_LOST rank={rank} " + json.dumps(e.record()),
               flush=True)
         sys.exit(RANK_LOST_EXIT_CODE)
+    except RankJoinError as e:
+        # a joiner announced: checkpoint already durable (run_elastic
+        # saved before raising) — exit 23 so the supervisor grows W+k
+        print(f"WORKER_RANK_JOIN rank={rank} " + json.dumps(e.record()),
+              flush=True)
+        sys.exit(RANK_JOIN_EXIT_CODE)
     mem.stop_heartbeats()
     mem.leave()
     print(

@@ -1,0 +1,423 @@
+"""Grow-to-fit elastic world expansion (train/grow.py +
+partition.unfold_partition + plan.reshard_vertex_data growth-direction +
+supervise_group on_rank_join): deterministic waterfill donations, the
+fold/unfold round trip, vertex-identity-preserving checkpoint resharding
+to a LARGER world, atomic generation adoption, the grow-then-shrink
+generation chain — and THE rank-join acceptance pin: a joiner announcing
+into a live 2-rank world is detected at a step boundary, the world grows
+2 -> 3 through a background re-plan, and the resumed expanded run is
+bit-identical (params + opt_state) to a fault-free 3-rank run restored
+from the same post-grow checkpoint.
+
+Compile-free throughout (same budget discipline as test_shrink.py): host
+numpy state, the streaming plan builder, subprocess workers that never
+jit.  The sigterm crash-window pins (commit boundary + mid-shard-stream)
+live in the grow CLI selftest, registered in scripts/check.py.
+"""
+
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dgraph_tpu.partition import (
+    fold_partition,
+    renumber_contiguous,
+    unfold_partition,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# unfold_partition: the deterministic waterfill inverse
+# ---------------------------------------------------------------------------
+
+
+def test_unfold_partition_donates_tails_to_newcomers():
+    part = np.repeat(np.arange(2), [8, 8])
+    new, donors = unfold_partition(part, 2, 1)
+    # waterfill level 6: each donor sheds its 2 highest-id vertices
+    assert donors == {0: 2, 1: 2}
+    counts = np.bincount(new, minlength=3)
+    assert counts.tolist() == [6, 6, 4]
+    # kept vertices never move — the keepers are each block's PREFIX
+    assert new[:6].tolist() == [0] * 6
+    assert new[8:14].tolist() == [1] * 6
+    # donated vertices are the TAILS, handed to the newcomer
+    assert new[[6, 7, 14, 15]].tolist() == [2, 2, 2, 2]
+
+
+def test_unfold_partition_balances_2_to_4():
+    part = np.repeat(np.arange(2), [8, 8])
+    new, donors = unfold_partition(part, 2, 2)
+    assert donors == {0: 4, 1: 4}
+    assert np.bincount(new, minlength=4).tolist() == [4, 4, 4, 4]
+    # newcomer chunks are contiguous in vertex order: rank 2 gets the
+    # earlier donated vertices, rank 3 the later ones
+    assert new[[4, 5, 6, 7]].tolist() == [2, 2, 2, 2]
+    assert new[[12, 13, 14, 15]].tolist() == [3, 3, 3, 3]
+
+
+def test_unfold_partition_uneven_blocks_stay_leveled():
+    part = np.repeat(np.arange(3), [9, 3, 6])
+    new, donors = unfold_partition(part, 3, 1)
+    counts = np.bincount(new, minlength=4)
+    assert int(counts.sum()) == 18
+    # no existing rank above the waterfill level, newcomer at most level
+    assert counts[:3].max() <= max(counts[3], counts[:3].max())
+    assert counts.max() - counts.min() <= 3
+    # only over-level ranks donate
+    assert set(donors) <= {0, 2}
+
+
+def test_unfold_partition_deterministic_and_pure():
+    rng = np.random.default_rng(11)
+    part = rng.integers(0, 4, 100)
+    before = part.copy()
+    a, da = unfold_partition(part, 4, 2)
+    b, db = unfold_partition(part, 4, 2)
+    np.testing.assert_array_equal(a, b)
+    assert da == db
+    np.testing.assert_array_equal(part, before)  # input untouched
+
+
+def test_unfold_partition_rejects_bad_inputs():
+    part = np.array([0, 1])
+    with pytest.raises(ValueError):
+        unfold_partition(part, 2, 0)
+    with pytest.raises(ValueError):
+        unfold_partition(np.array([0, 5]), 2, 1)  # names rank >= W
+
+
+def test_unfold_fold_round_trip_identity():
+    """fold(unfold(p)) == p when the original blocks are balanced:
+    killing exactly the newcomers undoes the growth vertex for vertex,
+    because fold's waterfill sends every donated vertex straight back to
+    its donor."""
+    for W, k, blocks in ((2, 1, [8, 8]), (2, 2, [8, 8]), (4, 2, [6] * 4)):
+        part = np.repeat(np.arange(W), blocks)
+        grown, _ = unfold_partition(part, W, k)
+        restored, survivor_map = fold_partition(
+            grown, W + k, list(range(W, W + k))
+        )
+        np.testing.assert_array_equal(restored, part)
+        assert survivor_map == {r: r for r in range(W)}
+
+
+def test_unfold_fold_round_trip_keepers_stay_put():
+    """On UNBALANCED blocks fold may re-level the donated vertices, but
+    the round trip still never moves a vertex unfold kept in place — the
+    locality contract both directions share."""
+    part = np.repeat(np.arange(3), [9, 3, 6])
+    grown, _ = unfold_partition(part, 3, 2)
+    restored, survivor_map = fold_partition(grown, 5, [3, 4])
+    assert survivor_map == {0: 0, 1: 1, 2: 2}
+    keepers = grown < 3  # vertices unfold left on their original rank
+    np.testing.assert_array_equal(restored[keepers], part[keepers])
+    # vertex conservation: same total, a valid 3-way partition
+    assert int(np.bincount(restored, minlength=3).sum()) == part.size
+
+
+# ---------------------------------------------------------------------------
+# reshard_vertex_data growth direction: rows follow their vertex to W+k
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k,n_pad_new", [(1, 4), (2, 4)])
+def test_reshard_vertex_data_growth_parity(k, n_pad_new):
+    """2 -> 2+k reshard vs the per-vertex oracle: unsharding the grown
+    world and undoing the renumber must recover every original row."""
+    from dgraph_tpu.plan import reshard_vertex_data, unshard_vertex_data
+
+    rng = np.random.default_rng(3)
+    old_counts = np.array([5, 4])
+    V = int(old_counts.sum())
+    g = rng.normal(size=(V, 3))
+    x = np.zeros((2, 6, 3))  # n_pad_old=6 > max count
+    off = 0
+    for r, c in enumerate(old_counts):
+        x[r, :c] = g[off: off + c]
+        off += c
+    part = np.repeat(np.arange(2), old_counts)
+    grown, _ = unfold_partition(part, 2, k)
+    ren = renumber_contiguous(grown, 2 + k)
+    out = reshard_vertex_data(x, old_counts, ren.inv, ren.counts, n_pad_new)
+    assert out.shape == (2 + k, n_pad_new, 3)
+    back = unshard_vertex_data(out, ren.counts)
+    np.testing.assert_array_equal(back[ren.perm], g)
+    for r, c in enumerate(ren.counts):
+        assert np.all(out[r, c:] == 0)  # pad rows stay zero
+
+
+# ---------------------------------------------------------------------------
+# grow_world: the generational transition
+# ---------------------------------------------------------------------------
+
+
+def test_grow_world_adopts_and_reshards(tmp_path):
+    from dgraph_tpu.train import grow, shrink
+    from dgraph_tpu.train.checkpoint import restore_checkpoint
+
+    run = str(tmp_path / "run")
+    seed = grow._seed_world(run, n=16, world=2)
+
+    # tokens assigned to new ranks in SORTED order regardless of input
+    rec = grow.grow_world(run, tokens=["node-b", "node-a"])
+    assert rec["generation"] == 1 and rec["world_size"] == 4
+    assert rec["resume_step"] == 3
+    last = rec["join_history"][-1]
+    assert last["joined"] == {"node-a": 2, "node-b": 3}
+    assert last["generation"] == 0 and last["resume_step"] == 3
+    # the pointer IS the adoption
+    assert shrink.read_world(run)["generation"] == 1
+
+    g1 = np.load(shrink.graph_path(run, 1))
+    # every original vertex survives the unfold exactly once
+    assert sorted(g1["orig_ids"].tolist()) == sorted(
+        seed["orig"].tolist())
+    assert len(g1["counts"]) == 4 and int(g1["counts"].sum()) == 16
+    offs = np.concatenate([[0], np.cumsum(g1["counts"])])
+    for r in range(4):
+        got = restore_checkpoint(shrink.rank_ckpt_dir(run, 1, r))
+        assert int(got["step"]) == 3
+        w = np.asarray(got["state"]["w"])
+        orig_r = g1["orig_ids"][offs[r]: offs[r + 1]]
+        np.testing.assert_array_equal(w[: g1["counts"][r]], orig_r + 1.0)
+        assert np.all(w[g1["counts"][r]:] == 0)
+        assert got["state"]["lr"] == 0.5  # replicated leaf carried over
+
+
+def test_grow_world_requires_pending_joins(tmp_path):
+    from dgraph_tpu.train import grow, shrink
+
+    run = str(tmp_path / "run")
+    grow._seed_world(run)
+    with pytest.raises(grow.GrowError) as ei:
+        grow.grow_world(run)  # nobody announced
+    assert "no pending join" in str(ei.value)
+    assert shrink.read_world(run)["generation"] == 0
+
+
+def test_grow_world_requires_consistent_cut(tmp_path):
+    from dgraph_tpu.train import grow, shrink
+
+    run = str(tmp_path / "run")
+    grow._seed_world(run)
+    # rank 1 loses its checkpoints: no step durable on ALL old ranks
+    shutil.rmtree(shrink.rank_ckpt_dir(run, 0, 1))
+    with pytest.raises(grow.GrowError) as ei:
+        grow.grow_world(run, tokens=["node-a"])
+    assert "durable on all" in str(ei.value)
+    # the failed transition changed nothing the readers see
+    assert shrink.read_world(run)["generation"] == 0
+
+
+# ---------------------------------------------------------------------------
+# generation chain: g0 --grow--> g1 --shrink--> g2, every plan verified
+# ---------------------------------------------------------------------------
+
+
+def test_grow_then_shrink_generation_chain(tmp_path):
+    """Grow and shrink transitions compose into one self-describing
+    generation chain; each generation's plan passes validate_plan and
+    the newcomer's later loss folds its block back cleanly."""
+    from dgraph_tpu.plan import load_sharded_plan, validate_plan
+    from dgraph_tpu.train import grow, shrink
+    from dgraph_tpu.train.checkpoint import restore_checkpoint
+
+    run = str(tmp_path / "run")
+    seed = grow._seed_world(run, n=16, world=2)
+
+    rec1 = grow.grow_world(run, tokens=["node-a"])
+    assert (rec1["generation"], rec1["world_size"]) == (1, 3)
+    grants = grow.grant_joined(run, rec1)
+    assert grants["node-a"]["rank"] == 2
+
+    rec2 = shrink.shrink_world(run, [2])  # the newcomer dies right back
+    assert (rec2["generation"], rec2["world_size"]) == (2, 2)
+    assert rec2["join_history"][-1]["generation"] == 0
+    assert rec2["lost_history"][-1] == {
+        "generation": 1, "lost": [2], "resume_step": 3,
+    }
+    assert shrink.read_world(run)["generation"] == 2
+
+    for gen, world in ((0, 2), (1, 3), (2, 2)):
+        plan, _ = load_sharded_plan(shrink.plan_dir(run, gen),
+                                    load_layout=False)
+        assert plan.world_size == world
+        validate_plan(plan)
+        g = np.load(shrink.graph_path(run, gen))
+        # vertex identity is conserved across every transition
+        assert sorted(g["orig_ids"].tolist()) == sorted(
+            seed["orig"].tolist())
+
+    # the surviving rows still carry their per-vertex payload after the
+    # round trip through the grown world
+    g2 = np.load(shrink.graph_path(run, 2))
+    offs = np.concatenate([[0], np.cumsum(g2["counts"])])
+    for r in range(2):
+        got = restore_checkpoint(shrink.rank_ckpt_dir(run, 2, r))
+        w = np.asarray(got["state"]["w"])
+        orig_r = g2["orig_ids"][offs[r]: offs[r + 1]]
+        np.testing.assert_array_equal(w[: g2["counts"][r]], orig_r + 1.0)
+
+
+def test_grown_generation_passes_spmd_audit(tmp_path):
+    """Cross-rank SPMD identity over a freshly-grown generation's plan:
+    every rank of the W+k world lowers the identical module from its own
+    shard-subset view (one impl/program pair — the audit is lower-only
+    but tier-1 pays every extra lowering)."""
+    from dgraph_tpu.analysis.spmd import audit_plan_dir_spmd
+    from dgraph_tpu.analysis.trace import _train_program
+    from dgraph_tpu.train import grow, shrink
+
+    run = str(tmp_path / "run")
+    grow._seed_world(run, n=16, world=2)
+    rec = grow.grow_world(run, tokens=["node-a"])
+    rep = audit_plan_dir_spmd(
+        shrink.plan_dir(run, rec["generation"]),
+        impls=("all_to_all",),
+        programs={"train_step": _train_program},
+    )
+    assert rep["ok"], rep["failures"]
+    assert rep["world_size"] == 3
+    for prec in rep["programs"]:
+        assert prec["identical"], prec
+        assert len(set(prec["module_hash"].values())) == 1
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance pin: join -> detect -> grow -> bit-identical resume
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_join_detect_grow_resume_bit_identical(tmp_path):
+    """A joiner announces into a live 2-rank world mid-epoch -> both
+    members detect the join at a step boundary, checkpoint, and exit 23
+    -> supervise_group runs the grow-to-fit recovery (background re-plan
+    at W=3 + checkpoint reshard + atomic adoption + grant) -> the
+    resumed 3-rank run completes and is BIT-IDENTICAL to a fault-free
+    3-rank run restored from the same post-grow checkpoint — and exact
+    against the global per-vertex oracle."""
+    import dgraph_tpu.comm.membership as ms
+    from dgraph_tpu.train import grow, shrink
+    from dgraph_tpu.train.checkpoint import latest_step, restore_checkpoint
+    from tests.test_shrink import _global_oracle, _run_group
+
+    rng = np.random.default_rng(9)
+    n, W, steps, sleep_s = 16, 2, 24, 0.1
+    edges = rng.integers(0, n, (2, 40)).astype(np.int64)
+    run_a = str(tmp_path / "chaotic")
+    shrink.init_world(run_a, edges, n, W, pad_multiple=2, lease_s=2.0)
+
+    run_b = str(tmp_path / "oracle")
+    snapshots, grant_box = [], []
+
+    def joiner_main():
+        # a real prospective member: waits until the step-3 cut is
+        # durable on BOTH ranks, then announces into the LIVE
+        # generation's membership dir and keeps the lease fresh until
+        # the supervisor's grant lands
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if all((latest_step(shrink.rank_ckpt_dir(run_a, 0, r)) or -1)
+                   >= 3 for r in range(W)):
+                break
+            time.sleep(0.05)
+        j = ms.Joiner(shrink.membership_dir(run_a, 0, 0), "newcomer-a",
+                      generation=0, lease_s=5.0)
+        while time.monotonic() < deadline:
+            j.announce()
+            got = j.grant()
+            if got is not None:
+                grant_box.append(got)
+                return
+            time.sleep(0.2)
+
+    def on_rank_join(world, attempt):
+        rec = grow.grow_world(run_a, attempt=attempt)
+        grow.grant_joined(run_a, rec, attempt=attempt)
+        # snapshot the freshly-adopted grown world BEFORE anyone resumes
+        # in it: the fault-free oracle replays from this exact state
+        shutil.copytree(run_a, run_b)
+        snapshots.append(rec)
+        return rec["world_size"]
+
+    joiner = threading.Thread(target=joiner_main, name="joiner")
+    joiner.start()
+    try:
+        lineage = _run_group(run_a, steps, W, sleep_s,
+                             on_rank_join=on_rank_join)
+    finally:
+        joiner.join(timeout=120.0)
+    assert lineage["final_exit_code"] == 0, json.dumps(lineage, indent=1)
+    assert lineage["final_world_size"] == 3
+    assert lineage["grows"] == [
+        {"attempt": 0, "old_world": 2, "new_world": 3}
+    ]
+    a0, a1 = lineage["attempts"]
+    ranks0 = {r["rank"]: r for r in a0["ranks"]}
+    # BOTH members observed the join and exited 23 after a durable save
+    for r in range(W):
+        assert ranks0[r]["outcome"] == "rank_join"
+        assert ranks0[r]["exit_code"] == 23
+    assert a1["world_size"] == 3 and a1["outcome"] == "ok"
+    # the joiner's rendezvous completed: granted rank 2 in generation 1
+    assert grant_box and grant_box[0]["rank"] == 2
+    assert grant_box[0]["generation"] == 1
+    assert grant_box[0]["world_size"] == 3
+    # the resumed attempt started from the grow's consistent cut
+    resume_step = snapshots[0]["resume_step"]
+    assert 3 <= resume_step < steps
+    assert snapshots[0]["join_history"][-1]["joined"] == {"newcomer-a": 2}
+
+    # fault-free W+1 oracle: the SAME post-grow snapshot restored and
+    # driven by the SAME step function the worker runs (imported, not
+    # reimplemented), replayed in-process per rank — identical code on
+    # identical state, no 4th jax subprocess start
+    from tests._rank_worker import make_step_fn
+
+    g1 = np.load(shrink.graph_path(run_b, 1))
+    offs = np.concatenate([[0], np.cumsum(g1["counts"])])
+    for r in range(3):
+        final_a = restore_checkpoint(shrink.rank_ckpt_dir(run_a, 1, r))
+        assert int(final_a["step"]) == steps
+        got = restore_checkpoint(shrink.rank_ckpt_dir(run_b, 1, r))
+        assert int(got["step"]) == resume_step
+        count = int(g1["counts"][r])
+        orig_r = g1["orig_ids"][offs[r]: offs[r + 1]]
+        n_pad = np.asarray(got["state"]["params"]["w"]).shape[0]
+        step_fn = make_step_fn(orig_r, count, n_pad, 0.0)
+        state_b = {
+            "params": {"w": np.asarray(got["state"]["params"]["w"])},
+            "opt_state": {"m": np.asarray(got["state"]["opt_state"]["m"])},
+        }
+        for _ in range(resume_step, steps):
+            state_b = step_fn(state_b)
+
+        # THE pin: params + opt_state bit-identical, every rank
+        np.testing.assert_array_equal(
+            np.asarray(final_a["state"]["params"]["w"]),
+            state_b["params"]["w"],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(final_a["state"]["opt_state"]["m"]),
+            state_b["opt_state"]["m"],
+        )
+
+        # and CORRECT: exact against the global per-vertex recurrence
+        w_want, m_want = _global_oracle(orig_r, steps)
+        np.testing.assert_allclose(
+            np.asarray(final_a["state"]["params"]["w"])[:count], w_want,
+            rtol=0, atol=0,
+        )
+        np.testing.assert_allclose(
+            np.asarray(final_a["state"]["opt_state"]["m"])[:count], m_want,
+            rtol=0, atol=0,
+        )

@@ -266,6 +266,136 @@ def test_barrier_fails_fast_on_rank_loss(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# join announcements: the grow-to-fit rendezvous (train/grow.py's feed)
+# ---------------------------------------------------------------------------
+
+
+def make_joiner(tmp_path, clock, token="node-x1", **kw):
+    return ms.Joiner(str(tmp_path), token, generation=0, lease_s=2.0,
+                     clock=clock, sleep=clock.sleep, **kw)
+
+
+def test_join_judged_from_first_observed_seq(tmp_path):
+    # THE joiner-ageing pin: the observer's clock is 1000 s past its own
+    # start when it FIRST sees the announcement — freshness must be
+    # judged from first observation of the seq, never from any embedded
+    # wall time, or every join announced before the observer's poll
+    # would be born expired
+    clock, (a,) = make_world(tmp_path, 1, lease_s=2.0)
+    a.heartbeat()
+    a.poll()
+    j = make_joiner(tmp_path, clock)
+    j.announce()
+    clock.sleep(1000.0)
+    evs = a.poll()
+    joins = [e for e in evs if e.kind == "join_request"]
+    assert [e.token for e in joins] == ["node-x1"]
+    assert joins[0].generation == 0
+    assert a.pending_joins() == ("node-x1",)
+    # emitted ONCE per token, and a quiet follow-up poll inside the
+    # lease keeps it pending
+    clock.sleep(1.0)
+    assert [e for e in a.poll() if e.kind == "join_request"] == []
+    assert a.pending_joins() == ("node-x1",)
+
+
+def test_join_expiry_is_quiet_withdrawal(tmp_path):
+    # a joiner that stops announcing ages out of the pending set with NO
+    # event: withdrawal is free, never a rank_lost
+    clock, (a,) = make_world(tmp_path, 1, lease_s=2.0)
+    a.heartbeat()
+    a.poll()
+    j = make_joiner(tmp_path, clock)
+    j.announce()
+    a.poll()
+    assert a.pending_joins() == ("node-x1",)
+    clock.sleep(2.5)
+    evs = a.poll()
+    assert evs == []
+    assert a.pending_joins() == ()
+    # ...and a RE-announcement (new seq) is a fresh request again
+    j.announce()
+    evs = a.poll()
+    assert [e.token for e in evs if e.kind == "join_request"] == ["node-x1"]
+    assert a.pending_joins() == ("node-x1",)
+
+
+def test_join_refresh_keeps_lease_alive(tmp_path):
+    # an announcing joiner (seq advancing) never ages out mid-wait
+    clock, (a,) = make_world(tmp_path, 1, lease_s=2.0)
+    a.heartbeat()
+    a.poll()
+    j = make_joiner(tmp_path, clock)
+    for _ in range(3):
+        j.announce()
+        clock.sleep(1.5)  # inside the lease per refresh, 4.5 s total
+        a.poll()
+        assert a.pending_joins() == ("node-x1",)
+
+
+def test_joiner_join_rendezvous_returns_grant(tmp_path):
+    clock, (a,) = make_world(tmp_path, 1, lease_s=2.0)
+    j = make_joiner(tmp_path, clock)
+    assert j.grant() is None
+    # the supervisor's answer names the NEXT generation's grown world
+    ms.grant_join(str(tmp_path), "node-x1", rank=1, generation=1,
+                  world_size=2)
+    got = j.join(deadline_s=10.0)
+    assert (got["rank"], got["generation"], got["world_size"]) == (1, 1, 2)
+    # an ungranted token times out naming the join
+    j2 = make_joiner(tmp_path, clock, token="node-x2")
+    with pytest.raises(ms.DeadlineExceeded) as ei:
+        j2.join(deadline_s=3.0)
+    assert "node-x2" in str(ei.value)
+
+
+def test_join_announce_fires_chaos_point(tmp_path):
+    clock, _ = make_world(tmp_path, 1)
+    j = make_joiner(tmp_path, clock)
+    chaos.arm("comm.join=raise@1")  # seq counter starts at 1
+    with pytest.raises(chaos.ChaosFault):
+        j.announce()
+    chaos.disarm()
+    # join() retries through the fault like rendezvous does
+    chaos.arm("comm.join=raise@0:count=2")
+    ms.grant_join(str(tmp_path), "node-x1", rank=2, generation=1,
+                  world_size=3)
+    assert j.join(deadline_s=30.0)["rank"] == 2
+
+
+def test_read_roster_renders_cross_generation_joins(tmp_path):
+    # the roster must make a grow rendezvous legible after the fact:
+    # join entries keyed "join:<token>", granted flag + the rank/
+    # generation the supervisor answered with (generation g+1 — the
+    # grant crosses generations by design)
+    clock, (a, b) = make_world(tmp_path, 2, lease_s=2.0)
+    a.heartbeat(), b.heartbeat()
+    make_joiner(tmp_path, clock, token="node-g").announce()
+    make_joiner(tmp_path, clock, token="node-u").announce()
+    ms.grant_join(str(tmp_path), "node-g", rank=2, generation=1,
+                  world_size=3)
+    roster = ms.read_roster(str(tmp_path))
+    assert sorted(k for k in roster if isinstance(k, int)) == [0, 1]
+    granted = roster["join:node-g"]
+    assert granted["granted"] is True
+    assert granted["granted_rank"] == 2
+    assert granted["granted_generation"] == 1
+    ungranted = roster["join:node-u"]
+    assert ungranted["granted"] is False
+    assert "granted_rank" not in ungranted
+
+
+def test_rank_join_error_record_and_exit_code():
+    e = ms.RankJoinError(("node-b", "node-a"),
+                         (ms.JoinRequest(token="node-a", generation=0),))
+    assert e.tokens == ("node-a", "node-b")  # sorted, deterministic
+    rec = e.record()
+    assert rec["exit_code"] == ms.RANK_JOIN_EXIT_CODE == 23
+    assert rec["kind"] == "rank_join_exit"
+    json.dumps(rec)
+
+
+# ---------------------------------------------------------------------------
 # rank identity from the supervisor's env export
 # ---------------------------------------------------------------------------
 
